@@ -1,0 +1,17 @@
+"""localai_tpu — a TPU-native, OpenAI-API-compatible inference framework.
+
+A ground-up re-design of the capability surface of LocalAI
+(reference: skyscope-sentinel/LocalAI) for TPU hardware:
+
+- control plane: asyncio HTTP server (OpenAI + LocalAI-native routes),
+  YAML model configs, templating, grammar-constrained function calling,
+  model galleries, backend process lifecycle   (reference: Go core, L3-L7)
+- process boundary: one gRPC contract, many backend processes
+  (reference: backend/backend.proto)
+- compute plane: a first-class JAX/XLA engine — safetensors → sharded
+  jax.Array over an ICI Mesh, continuous-batching decode as a jitted
+  slot-array step, Pallas kernels for the hot ops
+  (reference role: backend/cpp/llama-cpp grpc-server + vLLM)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,1 @@
+from localai_tpu.models.llama import LlamaConfig, init_params, param_specs

@@ -1,0 +1,15 @@
+from localai_tpu.ops.norms import rms_norm, layer_norm
+from localai_tpu.ops.rope import RopeConfig, rope_freqs, apply_rope
+from localai_tpu.ops.attention import mha_prefill, mha_decode
+from localai_tpu.ops import sampling
+
+__all__ = [
+    "rms_norm",
+    "layer_norm",
+    "RopeConfig",
+    "rope_freqs",
+    "apply_rope",
+    "mha_prefill",
+    "mha_decode",
+    "sampling",
+]

@@ -1,0 +1,86 @@
+"""Attention reference implementations (pure XLA).
+
+Layouts (chosen so the MXU sees large [tokens, head_dim] matmuls and the
+sharding layer can shard the head axis over the `model` mesh axis):
+
+  q:        [B, S, H, D]
+  k/v:      [B, S, KVH, D]      (GQA: H % KVH == 0)
+  kv cache: [B, T, KVH, D]      (slot-contiguous cache, T = max context)
+
+Softmax is computed in float32; matmuls stay in the input dtype (bf16).
+The Pallas flash/ragged kernels in localai_tpu/ops/pallas/ override these on
+TPU; these XLA versions are the semantic reference and the CPU-mesh test path.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _group_query_heads(q, num_kv_heads):
+    b, s, h, d = q.shape
+    return q.reshape(b, s, num_kv_heads, h // num_kv_heads, d)
+
+
+def _softcap(logits, cap):
+    if cap is None or cap <= 0:
+        return logits
+    return jnp.tanh(logits / cap) * cap
+
+
+def mha_prefill(q, k, v, lengths, *, scale=None, softcap=None, sliding_window=None):
+    """Causal self-attention over padded sequences.
+
+    lengths: [B] int32 — valid token count per sequence; padded tail is masked.
+    sliding_window: optional int — Mistral-style local attention window.
+    Returns [B, S, H, D].
+    """
+    b, s, h, d = q.shape
+    kvh = k.shape[2]
+    scale = scale if scale is not None else d ** -0.5
+
+    qg = _group_query_heads(q, kvh)  # [B,S,KVH,G,D]
+    logits = jnp.einsum("bskgd,btkd->bkgst", qg, k).astype(jnp.float32) * scale
+    logits = _softcap(logits, softcap)
+
+    pos = jnp.arange(s)
+    causal = pos[:, None] >= pos[None, :]                      # [S,T]
+    valid = pos[None, :] < lengths[:, None]                    # [B,T]
+    mask = causal[None, :, :] & valid[:, None, :]              # [B,S,T]
+    if sliding_window is not None and sliding_window > 0:
+        mask = mask & (pos[:, None] - pos[None, :] < sliding_window)[None]
+    logits = jnp.where(mask[:, None, None, :, :], logits, NEG_INF)
+
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bkgst,btkd->bskgd", probs, v)
+    return out.reshape(b, s, h, d)
+
+
+def mha_decode(q, k_cache, v_cache, lengths, *, scale=None, softcap=None,
+               sliding_window=None):
+    """Single-token decode attention against a slot-contiguous KV cache.
+
+    q: [B, 1, H, D]; k_cache/v_cache: [B, T, KVH, D]; lengths: [B] — number of
+    valid cache entries per slot INCLUDING the token being decoded.
+    Returns [B, 1, H, D].
+    """
+    b, _, h, d = q.shape
+    t = k_cache.shape[1]
+    kvh = k_cache.shape[2]
+    scale = scale if scale is not None else d ** -0.5
+
+    qg = _group_query_heads(q, kvh)[:, 0]                       # [B,KVH,G,D]
+    logits = jnp.einsum("bkgd,btkd->bkgt", qg, k_cache).astype(jnp.float32) * scale
+    logits = _softcap(logits, softcap)
+
+    pos = jnp.arange(t)
+    mask = pos[None, :] < lengths[:, None]                      # [B,T]
+    if sliding_window is not None and sliding_window > 0:
+        mask = mask & (pos[None, :] >= lengths[:, None] - sliding_window)
+    logits = jnp.where(mask[:, None, None, :], logits, NEG_INF)
+
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bkgt,btkd->bkgd", probs, v_cache)
+    return out.reshape(b, 1, h, d)

@@ -1,0 +1,29 @@
+"""Normalization ops.
+
+Computed in float32 regardless of input dtype (bf16 accumulation loses too
+much precision for variance), cast back to the input dtype so the surrounding
+matmuls stay on the MXU in bf16.
+"""
+import jax.numpy as jnp
+
+
+def rms_norm(x, weight, eps: float = 1e-6, *, offset: float = 0.0):
+    """RMSNorm. `offset=1.0` gives the Gemma convention (weight stored as w-1)."""
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    y = xf * (1.0 / jnp.sqrt(var + eps))
+    w = weight.astype(jnp.float32) + offset
+    return (y * w).astype(dtype)
+
+
+def layer_norm(x, weight, bias, eps: float = 1e-5):
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    y = (xf - mean) / jnp.sqrt(var + eps)
+    y = y * weight.astype(jnp.float32)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return y.astype(dtype)

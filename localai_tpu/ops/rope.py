@@ -1,0 +1,98 @@
+"""Rotary position embeddings with the long-context scaling family.
+
+The reference exposes RoPE knobs per model YAML (rope_freq_base, rope_freq_scale,
+YaRN ext/attn/beta — /root/reference/backend/backend.proto:191-192,240-243 and
+core/config/model_config.go:232-236); we keep that exact knob surface but
+compute everything as precomputed cos/sin tables applied on-device.
+
+Scaling modes: none | linear | yarn | llama3 (HF rope_scaling parity).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class RopeConfig:
+    head_dim: int = 128
+    base: float = 10000.0           # rope_freq_base
+    scaling: str = "none"           # none | linear | yarn | llama3
+    scale_factor: float = 1.0       # 1/rope_freq_scale (HF "factor")
+    original_max_position: int = 4096
+    # yarn
+    beta_fast: float = 32.0
+    beta_slow: float = 1.0
+    attn_factor: float = 1.0
+    # llama3
+    low_freq_factor: float = 1.0
+    high_freq_factor: float = 4.0
+
+
+def _yarn_find_dim(num_rot: float, dim: int, base: float, max_pos: int) -> float:
+    return (dim * math.log(max_pos / (num_rot * 2 * math.pi))) / (2 * math.log(base))
+
+
+def rope_freqs(cfg: RopeConfig):
+    """Returns per-channel inverse frequencies [head_dim//2] (float32) and the
+    attention magnitude scale (mscale, used by yarn)."""
+    half = cfg.head_dim // 2
+    inv_freq = 1.0 / (cfg.base ** (jnp.arange(0, half, dtype=jnp.float32) / half * 2.0))
+    mscale = 1.0
+
+    if cfg.scaling == "linear":
+        inv_freq = inv_freq / cfg.scale_factor
+    elif cfg.scaling == "llama3":
+        # per-channel: high-freq dims untouched, low-freq dims scaled, smooth ramp between
+        low_wavelen = cfg.original_max_position / cfg.low_freq_factor
+        high_wavelen = cfg.original_max_position / cfg.high_freq_factor
+        wavelen = 2 * math.pi / inv_freq
+        smooth = (cfg.original_max_position / wavelen - cfg.low_freq_factor) / (
+            cfg.high_freq_factor - cfg.low_freq_factor
+        )
+        smooth = jnp.clip(smooth, 0.0, 1.0)
+        scaled = inv_freq / cfg.scale_factor
+        blended = (1 - smooth) * scaled + smooth * inv_freq
+        inv_freq = jnp.where(
+            wavelen > low_wavelen, scaled,
+            jnp.where(wavelen < high_wavelen, inv_freq, blended),
+        )
+    elif cfg.scaling == "yarn":
+        lo = max(math.floor(_yarn_find_dim(cfg.beta_fast, cfg.head_dim, cfg.base,
+                                           cfg.original_max_position)), 0)
+        hi = min(math.ceil(_yarn_find_dim(cfg.beta_slow, cfg.head_dim, cfg.base,
+                                          cfg.original_max_position)), half - 1)
+        ramp = jnp.clip((jnp.arange(half, dtype=jnp.float32) - lo) / max(hi - lo, 1), 0.0, 1.0)
+        # interpolation mask: 1 = interpolate (low freq), 0 = extrapolate (high freq)
+        interp = 1.0 - ramp
+        inv_freq = inv_freq / cfg.scale_factor * interp + inv_freq * (1.0 - interp)
+        mscale = cfg.attn_factor * (0.1 * math.log(cfg.scale_factor) + 1.0) if cfg.scale_factor > 1 else 1.0
+    elif cfg.scaling != "none":
+        raise ValueError(f"unknown rope scaling mode {cfg.scaling!r}")
+
+    return inv_freq, mscale
+
+
+def rope_table(cfg: RopeConfig, max_len: int):
+    """Precompute (cos, sin) tables of shape [max_len, head_dim//2] (float32)."""
+    inv_freq, mscale = rope_freqs(cfg)
+    t = jnp.arange(max_len, dtype=jnp.float32)
+    angles = t[:, None] * inv_freq[None, :]
+    return jnp.cos(angles) * mscale, jnp.sin(angles) * mscale
+
+
+def apply_rope(x, cos, sin, positions):
+    """Apply rotary embedding.
+
+    x: [..., seq, heads, head_dim]; positions: [..., seq] int32 indices into the
+    tables; cos/sin: [max_len, head_dim//2]. Uses the "split halves" (GPT-NeoX /
+    HF Llama) layout: channel i rotates with channel i + head_dim//2.
+    """
+    dtype = x.dtype
+    c = cos[positions][..., None, :]  # [..., seq, 1, half]
+    s = sin[positions][..., None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(dtype)

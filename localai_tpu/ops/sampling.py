@@ -1,0 +1,178 @@
+"""Batched, jit-safe token sampling — the PredictOptions knob surface.
+
+The reference's sampling knobs live in PredictOptions
+(/root/reference/backend/backend.proto:110-159) and are enforced inside
+llama.cpp's sampler chain. Here the whole chain is a single vectorized
+function over the slot batch, applied on-device every decode step:
+
+  penalties (repeat/presence/frequency over a per-slot token-count table)
+  → logit bias → temperature → top-k → top-p → min-p → typical-p → sample
+
+All per-slot knobs are device arrays [B] so slots with different settings
+share one jitted step (no recompilation per request mix). top_k/top_p/min_p
+use one shared descending sort of the logits — O(B·V·logV) but a single fused
+XLA op, MXU-free and bandwidth-bound, which is the right trade on TPU.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+@dataclasses.dataclass
+class SamplingParams:
+    """Host-side per-request sampling configuration (proto PredictOptions names)."""
+    temperature: float = 0.8
+    top_k: int = 40            # <=0 disables
+    top_p: float = 0.95        # >=1 disables
+    min_p: float = 0.0         # <=0 disables
+    typical_p: float = 1.0     # >=1 disables
+    repeat_penalty: float = 1.0
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
+    seed: int = -1             # <0 → draw from entropy
+    logit_bias: dict[int, float] | None = None
+    greedy: bool = False       # temperature<=0 → greedy
+
+    def normalized(self) -> "SamplingParams":
+        p = dataclasses.replace(self)
+        if p.temperature is None or p.temperature <= 0:
+            p.greedy = True
+            p.temperature = 1.0
+        if not p.top_k or p.top_k <= 0:
+            p.top_k = 0
+        if p.top_p is None or p.top_p <= 0:
+            p.top_p = 1.0
+        return p
+
+
+@dataclasses.dataclass
+class SamplerState:
+    """Device-side batched sampler state, one row per engine slot."""
+    temperature: jax.Array   # [B] f32
+    top_k: jax.Array         # [B] i32 (0 = off)
+    top_p: jax.Array         # [B] f32
+    min_p: jax.Array         # [B] f32
+    typical_p: jax.Array     # [B] f32
+    repeat_penalty: jax.Array    # [B] f32
+    presence_penalty: jax.Array  # [B] f32
+    frequency_penalty: jax.Array # [B] f32
+    greedy: jax.Array        # [B] bool
+    key: jax.Array           # [B, 2] u32 PRNG keys
+    token_counts: jax.Array  # [B, V] i32 — occurrences in prompt+generation
+    logit_bias: jax.Array    # [B, V] f32
+
+    @staticmethod
+    def init(batch: int, vocab: int) -> "SamplerState":
+        z = lambda d: jnp.zeros((batch,), d)
+        return SamplerState(
+            temperature=jnp.ones((batch,), jnp.float32),
+            top_k=z(jnp.int32),
+            top_p=jnp.ones((batch,), jnp.float32),
+            min_p=z(jnp.float32),
+            typical_p=jnp.ones((batch,), jnp.float32),
+            repeat_penalty=jnp.ones((batch,), jnp.float32),
+            presence_penalty=z(jnp.float32),
+            frequency_penalty=z(jnp.float32),
+            greedy=jnp.zeros((batch,), jnp.bool_),
+            key=jnp.zeros((batch, 2), jnp.uint32),
+            token_counts=jnp.zeros((batch, vocab), jnp.int32),
+            logit_bias=jnp.zeros((batch, vocab), jnp.float32),
+        )
+
+    def slot_row(self, params: SamplingParams, vocab: int, slot_seed: int):
+        """Host-side: build the row values for writing one slot (see engine)."""
+        p = params.normalized()
+        bias = jnp.zeros((vocab,), jnp.float32)
+        if p.logit_bias:
+            ids = jnp.array(list(p.logit_bias.keys()), jnp.int32)
+            vals = jnp.array(list(p.logit_bias.values()), jnp.float32)
+            bias = bias.at[ids].set(vals)
+        seed = p.seed if p.seed is not None and p.seed >= 0 else slot_seed
+        return dict(
+            temperature=jnp.float32(p.temperature),
+            top_k=jnp.int32(min(p.top_k, vocab)),
+            top_p=jnp.float32(p.top_p),
+            min_p=jnp.float32(p.min_p),
+            typical_p=jnp.float32(p.typical_p),
+            repeat_penalty=jnp.float32(p.repeat_penalty),
+            presence_penalty=jnp.float32(p.presence_penalty),
+            frequency_penalty=jnp.float32(p.frequency_penalty),
+            greedy=jnp.bool_(p.greedy),
+            key=jax.random.key_data(jax.random.PRNGKey(seed)).astype(jnp.uint32),
+            logit_bias=bias,
+        )
+
+
+def apply_penalties(logits, state: SamplerState):
+    """llama.cpp-semantics penalties: repeat penalty divides positive logits /
+    multiplies negative ones for seen tokens; presence/frequency subtract."""
+    counts = state.token_counts
+    seen = counts > 0
+    rp = state.repeat_penalty[:, None]
+    penalized = jnp.where(logits > 0, logits / rp, logits * rp)
+    logits = jnp.where(seen, penalized, logits)
+    logits = logits - seen.astype(jnp.float32) * state.presence_penalty[:, None]
+    logits = logits - counts.astype(jnp.float32) * state.frequency_penalty[:, None]
+    return logits
+
+
+def sample(logits, state: SamplerState):
+    """One sampling step. logits: [B, V] (any float dtype).
+
+    Returns (tokens [B] i32, new_keys [B,2], logprobs [B] f32 of chosen token).
+    """
+    b, v = logits.shape
+    logits = logits.astype(jnp.float32)
+    logits = apply_penalties(logits, state)
+    logits = logits + state.logit_bias
+    logits = logits / jnp.maximum(state.temperature[:, None], 1e-6)
+
+    # shared descending sort powers top-k / top-p / min-p / typical-p
+    sorted_logits = -jnp.sort(-logits, axis=-1)                 # [B,V] desc
+    order = jnp.argsort(-logits, axis=-1)                       # [B,V]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+
+    rank = jnp.arange(v)[None, :]
+    keep = jnp.ones((b, v), bool)
+    # top-k (0 = disabled)
+    k = jnp.where(state.top_k > 0, state.top_k, v)[:, None]
+    keep &= rank < k
+    # top-p: keep smallest prefix with cum >= p (always keep rank 0)
+    keep &= (cum - probs) < state.top_p[:, None]
+    # min-p: prob >= min_p * max_prob
+    keep &= probs >= state.min_p[:, None] * probs[:, :1]
+    # typical-p: keep tokens closest to expected entropy until mass >= typ_p
+    ent = -jnp.sum(probs * jnp.log(probs + 1e-10), axis=-1, keepdims=True)
+    dev = jnp.abs(-jnp.log(probs + 1e-10) - ent)
+    dev_order = jnp.argsort(dev, axis=-1)
+    typ_cum = jnp.cumsum(jnp.take_along_axis(probs, dev_order, axis=-1), axis=-1)
+    typ_keep_sorted_by_dev = (typ_cum - jnp.take_along_axis(probs, dev_order, axis=-1)) < state.typical_p[:, None]
+    typ_keep = jnp.zeros((b, v), bool).at[
+        jnp.arange(b)[:, None], dev_order
+    ].set(typ_keep_sorted_by_dev)
+    keep &= jnp.where(state.typical_p[:, None] >= 1.0, True, typ_keep)
+    keep = keep.at[:, 0].set(True)
+
+    masked = jnp.where(keep, sorted_logits, NEG_INF)
+    new_keys = jax.vmap(lambda kk: jax.random.split(jax.random.wrap_key_data(kk), 2))(
+        state.key
+    )
+    step_keys = jax.vmap(jax.random.wrap_key_data)(
+        jax.vmap(jax.random.key_data)(new_keys[:, 1])
+    )
+    sampled_rank = jax.vmap(lambda kk, lg: jax.random.categorical(kk, lg))(
+        step_keys, masked
+    )
+    sampled_rank = jnp.where(state.greedy, 0, sampled_rank)
+    tokens = jnp.take_along_axis(order, sampled_rank[:, None], axis=-1)[:, 0]
+
+    logprobs_sorted = jax.nn.log_softmax(masked, axis=-1)
+    tok_logprob = jnp.take_along_axis(logprobs_sorted, sampled_rank[:, None], axis=-1)[:, 0]
+    carry_keys = jax.vmap(jax.random.key_data)(new_keys[:, 0]).astype(jnp.uint32)
+    return tokens.astype(jnp.int32), carry_keys, tok_logprob

@@ -1,0 +1,27 @@
+"""Test harness: force an 8-device virtual CPU mesh before JAX initializes.
+
+The reference has no automated multi-node tests (SURVEY.md §4); we do better by
+running every sharding-sensitive test on a virtual 8-device CPU mesh, the
+TPU-idiomatic fake-cluster harness.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+import jax  # noqa: E402
+
+# numerics tests compare against f64 numpy references; keep CPU matmuls exact
+jax.config.update("jax_default_matmul_precision", "float32")
+
+
+@pytest.fixture(scope="session")
+def devices():
+    import jax
+
+    return jax.devices()

@@ -1,0 +1,100 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from localai_tpu.ops.norms import rms_norm
+from localai_tpu.ops.rope import RopeConfig, rope_table, apply_rope
+from localai_tpu.ops.attention import mha_prefill, mha_decode
+from localai_tpu.ops.sampling import SamplerState, SamplingParams, sample
+
+
+def test_rms_norm():
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8), jnp.float32)
+    w = jnp.ones((8,))
+    y = rms_norm(x, w)
+    rms = np.sqrt(np.mean(np.asarray(y) ** 2, -1))
+    np.testing.assert_allclose(rms, 1.0, rtol=1e-3)
+
+
+def test_rope_orthogonal_norm_preserved():
+    cfg = RopeConfig(head_dim=16)
+    cos, sin = rope_table(cfg, 32)
+    x = jax.random.normal(jax.random.PRNGKey(1), (1, 4, 2, 16))
+    pos = jnp.arange(4)[None, :]
+    y = apply_rope(x, cos, sin, pos)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(x), axis=-1),
+        np.linalg.norm(np.asarray(y), axis=-1), rtol=1e-4)
+
+
+def test_rope_scaling_modes():
+    for mode in ["linear", "yarn", "llama3"]:
+        cfg = RopeConfig(head_dim=16, scaling=mode, scale_factor=4.0,
+                         original_max_position=64)
+        cos, sin = rope_table(cfg, 128)
+        assert np.isfinite(np.asarray(cos)).all()
+
+
+def test_mha_prefill_against_naive():
+    B, S, H, KVH, D = 1, 6, 4, 2, 8
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (B, S, H, D))
+    k = jax.random.normal(jax.random.PRNGKey(1), (B, S, KVH, D))
+    v = jax.random.normal(jax.random.PRNGKey(2), (B, S, KVH, D))
+    out = mha_prefill(q, k, v, jnp.array([S]))
+    ref2 = np.zeros((S, H, D))
+    qf = np.asarray(q[0], np.float64)
+    kf = np.asarray(k[0], np.float64)
+    vf = np.asarray(v[0], np.float64)
+    for i in range(H):
+        j = i // (H // KVH)
+        logits = qf[:, i] @ kf[:, j].T / np.sqrt(D)
+        for s in range(S):
+            row = logits[s].copy()
+            row[s + 1:] = -1e30
+            e = np.exp(row - row.max())
+            ref2[s, i] = (e / e.sum()) @ vf[:, j]
+    np.testing.assert_allclose(np.asarray(out[0]), ref2, rtol=1e-4, atol=1e-5)
+
+
+def test_mha_decode_matches_prefill_last_row():
+    B, S, H, KVH, D = 2, 5, 4, 2, 8
+    q = jax.random.normal(jax.random.PRNGKey(0), (B, S, H, D))
+    k = jax.random.normal(jax.random.PRNGKey(1), (B, S, KVH, D))
+    v = jax.random.normal(jax.random.PRNGKey(2), (B, S, KVH, D))
+    lengths = jnp.array([S, S])
+    pre = mha_prefill(q, k, v, lengths)
+    T = 16
+    kc = jnp.zeros((B, T, KVH, D)).at[:, :S].set(k)
+    vc = jnp.zeros((B, T, KVH, D)).at[:, :S].set(v)
+    dec = mha_decode(q[:, S - 1:S], kc, vc, lengths)
+    np.testing.assert_allclose(np.asarray(dec[:, 0]), np.asarray(pre[:, S - 1]),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_sampling_greedy_and_topk():
+    B, V = 2, 50
+    st = SamplerState.init(B, V)
+    row = st.slot_row(SamplingParams(temperature=0.0), V, slot_seed=7)
+    for f, val in row.items():
+        setattr(st, f, getattr(st, f).at[0].set(val))
+    row1 = st.slot_row(SamplingParams(temperature=1.0, top_k=1, seed=3), V, 0)
+    for f, val in row1.items():
+        setattr(st, f, getattr(st, f).at[1].set(val))
+    logits = jnp.zeros((B, V)).at[:, 17].set(10.0)
+    toks, keys, lp = sample(logits, st)
+    assert int(toks[0]) == 17  # greedy picks max
+    assert int(toks[1]) == 17  # top_k=1 also forced
+
+
+def test_sampling_penalties_suppress_repeats():
+    B, V = 1, 16
+    st = SamplerState.init(B, V)
+    row = st.slot_row(SamplingParams(temperature=0.0, repeat_penalty=2.0), V, 0)
+    for f, val in row.items():
+        setattr(st, f, getattr(st, f).at[0].set(val))
+    st.token_counts = st.token_counts.at[0, 5].set(3)
+    logits = jnp.zeros((B, V)).at[0, 5].set(2.0).at[0, 9].set(1.5)
+    toks, _, _ = sample(logits, st)
+    # token 5 logit 2.0/2.0=1.0 < 1.5 → token 9 wins
+    assert int(toks[0]) == 9
