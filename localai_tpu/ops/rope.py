@@ -39,7 +39,8 @@ def rope_freqs(cfg: RopeConfig):
     """Returns per-channel inverse frequencies [head_dim//2] (float32) and the
     attention magnitude scale (mscale, used by yarn)."""
     half = cfg.head_dim // 2
-    inv_freq = 1.0 / (cfg.base ** (jnp.arange(0, half, dtype=jnp.float32) / half * 2.0))
+    # HF/Llama convention: base ** (-2i/dim) == base ** (-i/half)
+    inv_freq = 1.0 / (cfg.base ** (jnp.arange(0, half, dtype=jnp.float32) / half))
     mscale = 1.0
 
     if cfg.scaling == "linear":
@@ -65,9 +66,10 @@ def rope_freqs(cfg: RopeConfig):
         hi = min(math.ceil(_yarn_find_dim(cfg.beta_slow, cfg.head_dim, cfg.base,
                                           cfg.original_max_position)), half - 1)
         ramp = jnp.clip((jnp.arange(half, dtype=jnp.float32) - lo) / max(hi - lo, 1), 0.0, 1.0)
-        # interpolation mask: 1 = interpolate (low freq), 0 = extrapolate (high freq)
-        interp = 1.0 - ramp
-        inv_freq = inv_freq / cfg.scale_factor * interp + inv_freq * (1.0 - interp)
+        # extrapolate (keep original freq) below lo, interpolate (1/scale) above
+        # hi, blend in between — matches HF _compute_yarn_parameters where
+        # extrapolation_factor = 1 - ramp.
+        inv_freq = inv_freq / cfg.scale_factor * ramp + inv_freq * (1.0 - ramp)
         mscale = cfg.attn_factor * (0.1 * math.log(cfg.scale_factor) + 1.0) if cfg.scale_factor > 1 else 1.0
     elif cfg.scaling != "none":
         raise ValueError(f"unknown rope scaling mode {cfg.scaling!r}")

@@ -172,7 +172,10 @@ def sample(logits, state: SamplerState):
     sampled_rank = jnp.where(state.greedy, 0, sampled_rank)
     tokens = jnp.take_along_axis(order, sampled_rank[:, None], axis=-1)[:, 0]
 
-    logprobs_sorted = jax.nn.log_softmax(masked, axis=-1)
+    # logprob of the chosen token under the PRE-truncation distribution
+    # (post penalties/bias/temperature) — OpenAI-style logprobs must not be
+    # inflated by top-k/top-p renormalization.
+    logprobs_sorted = jax.nn.log_softmax(sorted_logits, axis=-1)
     tok_logprob = jnp.take_along_axis(logprobs_sorted, sampled_rank[:, None], axis=-1)[:, 0]
     carry_keys = jax.vmap(jax.random.key_data)(new_keys[:, 0]).astype(jnp.uint32)
     return tokens.astype(jnp.int32), carry_keys, tok_logprob

@@ -1,0 +1,8 @@
+from localai_tpu.parallel.mesh import (  # noqa: F401
+    MeshConfig,
+    activate_mesh,
+    build_mesh,
+    constrain,
+    current_mesh,
+    shard_params,
+)

@@ -1,0 +1,82 @@
+"""Device-mesh management — the TPU answer to the reference's parallelism menu.
+
+The reference scales by NCCL tensor-parallel (vllm/backend.py:106-107), ggml
+tensor_split (backend.proto:189) and cross-host ggml-RPC workers
+(grpc-server.cpp:256-278). Here all of that is ONE mechanism: a
+`jax.sharding.Mesh` over ('data','model') [+ optional 'seq' for ring
+attention], PartitionSpecs on params/activations, and XLA-inserted collectives
+riding ICI (intra-slice) / DCN (inter-slice via jax.distributed).
+
+`constrain` is the activation-sharding hint used inside model code. It is a
+no-op when no mesh has been activated (single-chip / plain CPU tests) and a
+HARD sharding constraint when one has — a wrong spec under a mesh raises
+instead of degrading to a silent no-op.
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import threading
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+_state = threading.local()
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Mesh shape knobs (YAML `tensor_parallel` etc. map here).
+
+    data × model must equal the device count; axes of size 1 are fine.
+    """
+    data: int = 1
+    model: int = 1
+
+    def axis_sizes(self) -> tuple[int, int]:
+        return self.data, self.model
+
+
+def build_mesh(cfg: MeshConfig | None = None, devices=None) -> Mesh:
+    """Build a ('data','model') mesh. Defaults to all devices on the model axis
+    (tensor parallelism), the common single-host serving layout."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if cfg is None:
+        cfg = MeshConfig(data=1, model=n)
+    d, m = cfg.axis_sizes()
+    if d * m != n:
+        raise ValueError(f"mesh {d}x{m} != {n} devices")
+    return Mesh(np.array(devices).reshape(d, m), ("data", "model"))
+
+
+def current_mesh() -> Mesh | None:
+    return getattr(_state, "mesh", None)
+
+
+@contextlib.contextmanager
+def activate_mesh(mesh: Mesh | None):
+    """Make `mesh` the ambient mesh for `constrain` within the block."""
+    prev = current_mesh()
+    _state.mesh = mesh
+    try:
+        yield mesh
+    finally:
+        _state.mesh = prev
+
+
+def constrain(x, spec: PartitionSpec):
+    """Apply a sharding constraint iff a mesh is active. NOTE: the ambient mesh
+    is captured at TRACE time — jit the model functions inside `activate_mesh`."""
+    mesh = current_mesh()
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def shard_params(params, specs, mesh: Mesh):
+    """device_put every leaf with its PartitionSpec → sharded jax.Arrays."""
+    return jax.tree_util.tree_map(
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)), params, specs
+    )

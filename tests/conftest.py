@@ -1,12 +1,19 @@
-"""Test harness: force an 8-device virtual CPU mesh before JAX initializes.
+"""Test harness: force a hermetic 8-device virtual CPU mesh.
 
 The reference has no automated multi-node tests (SURVEY.md §4); we do better by
-running every sharding-sensitive test on a virtual 8-device CPU mesh, the
+running every sharding-sensitive test on a virtual 8-device CPU mesh — the
 TPU-idiomatic fake-cluster harness.
+
+Two subtleties in this environment:
+- `JAX_PLATFORMS=axon` is exported AND an axon site hook registers the TPU
+  backend at interpreter start, so env-var tricks are too late.
+  `jax.config.update("jax_platforms", "cpu")` still wins because backend
+  selection is lazy — it must run before the first `jax.devices()` call.
+- XLA_FLAGS is read when the CPU client is created (also lazy), so setting it
+  here is early enough.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -16,12 +23,23 @@ if "xla_force_host_platform_device_count" not in flags:
 import pytest  # noqa: E402
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 # numerics tests compare against f64 numpy references; keep CPU matmuls exact
 jax.config.update("jax_default_matmul_precision", "float32")
+
+assert jax.devices()[0].platform == "cpu", "tests must run on CPU"
+assert len(jax.devices()) == 8, "virtual 8-device mesh required"
 
 
 @pytest.fixture(scope="session")
 def devices():
-    import jax
-
     return jax.devices()
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    """2x4 ('data','model') mesh over the virtual CPU devices."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()).reshape(2, 4), ("data", "model"))

@@ -1,0 +1,90 @@
+"""RoPE frequency tables must match the HF reference formulas exactly.
+
+Round-1 advisor finding: self-consistency tests (rotation preserves norm) hold
+for ANY frequency table, so they missed a doubled exponent and an inverted
+YaRN ramp. These tests pin our tables to transformers' rope-utils output.
+"""
+import numpy as np
+import pytest
+import torch
+
+from transformers import PretrainedConfig
+from transformers.modeling_rope_utils import ROPE_INIT_FUNCTIONS
+
+from localai_tpu.ops.rope import RopeConfig, rope_freqs
+
+
+def _hf_config(head_dim, base, max_pos, rope_scaling=None):
+    cfg = PretrainedConfig()
+    cfg.head_dim = head_dim
+    cfg.hidden_size = head_dim * 4
+    cfg.num_attention_heads = 4
+    cfg.rope_theta = base
+    cfg.max_position_embeddings = max_pos
+    cfg.rope_scaling = rope_scaling
+    # transformers >=4.54 reads rope params through rope_parameters
+    rp = {"rope_theta": base, "rope_type": "default"}
+    if rope_scaling:
+        rp.update(rope_scaling)
+    cfg.rope_parameters = rp
+    return cfg
+
+
+def _hf_freqs(rope_type, head_dim, base, max_pos, rope_scaling=None):
+    cfg = _hf_config(head_dim, base, max_pos, rope_scaling)
+    inv_freq, attn_scale = ROPE_INIT_FUNCTIONS[rope_type](cfg, device="cpu")
+    return np.asarray(inv_freq.to(torch.float64)), float(attn_scale)
+
+
+def test_default_matches_hf():
+    ours, _ = rope_freqs(RopeConfig(head_dim=128, base=500000.0))
+    theirs, scale = _hf_freqs("default", 128, 500000.0, 8192)
+    np.testing.assert_allclose(np.asarray(ours), theirs, rtol=1e-6)
+    assert scale == 1.0
+
+
+def test_linear_matches_hf():
+    ours, _ = rope_freqs(
+        RopeConfig(head_dim=64, base=10000.0, scaling="linear", scale_factor=4.0)
+    )
+    theirs, _ = _hf_freqs(
+        "linear", 64, 10000.0, 4096, {"rope_type": "linear", "factor": 4.0}
+    )
+    np.testing.assert_allclose(np.asarray(ours), theirs, rtol=1e-6)
+
+
+def test_llama3_matches_hf():
+    scaling = {
+        "rope_type": "llama3",
+        "factor": 8.0,
+        "low_freq_factor": 1.0,
+        "high_freq_factor": 4.0,
+        "original_max_position_embeddings": 8192,
+    }
+    ours, _ = rope_freqs(
+        RopeConfig(
+            head_dim=128, base=500000.0, scaling="llama3", scale_factor=8.0,
+            original_max_position=8192, low_freq_factor=1.0, high_freq_factor=4.0,
+        )
+    )
+    theirs, _ = _hf_freqs("llama3", 128, 500000.0, 8192, scaling)
+    np.testing.assert_allclose(np.asarray(ours), theirs, rtol=1e-6)
+
+
+def test_yarn_matches_hf():
+    scaling = {
+        "rope_type": "yarn",
+        "factor": 4.0,
+        "beta_fast": 32.0,
+        "beta_slow": 1.0,
+        "original_max_position_embeddings": 4096,
+    }
+    ours, mscale = rope_freqs(
+        RopeConfig(
+            head_dim=128, base=10000.0, scaling="yarn", scale_factor=4.0,
+            original_max_position=4096, beta_fast=32.0, beta_slow=1.0,
+        )
+    )
+    theirs, hf_mscale = _hf_freqs("yarn", 128, 10000.0, 4096, scaling)
+    np.testing.assert_allclose(np.asarray(ours), theirs, rtol=1e-5)
+    assert mscale == pytest.approx(hf_mscale, rel=1e-6)
