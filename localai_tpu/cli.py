@@ -56,7 +56,10 @@ def main(argv=None):
     sub.add_parser("version", help="print version")
 
     args = parser.parse_args(argv)
-    cmd = args.cmd or "run"
+    cmd = args.cmd
+    if cmd is None:
+        parser.print_help()
+        return 1
 
     if cmd == "version":
         from localai_tpu.version import __version__

@@ -1,0 +1,8 @@
+from localai_tpu.engine.loader import load_config, load_params, load_model  # noqa: F401
+from localai_tpu.engine.tokenizer import Tokenizer  # noqa: F401
+from localai_tpu.engine.engine import (  # noqa: F401
+    Engine,
+    EngineConfig,
+    GenRequest,
+    StepOutput,
+)

@@ -1,0 +1,391 @@
+"""Slot-based continuous-batching serving engine — the `update_slots` analog.
+
+Reference: llama.cpp's server loop (task queue + slots, wired to gRPC at
+/root/reference/backend/cpp/llama-cpp/grpc-server.cpp:69-97; stream path
+:571-995) and the MLX backend's stream_generate
+(/root/reference/backend/python/mlx/backend.py:193-231).
+
+TPU-first design — everything the XLA compiler sees is fixed-shape:
+- ONE decode computation over the full slot array [B] every step, compiled
+  once; inactive slots compute masked garbage (cheaper than recompiling).
+- prompt prefill is padded to a small set of length buckets (one compile per
+  bucket, reused forever).
+- per-slot sampler knobs are device arrays (ops/sampling.SamplerState), so any
+  mix of temperatures/top-k/penalties shares the same compiled step.
+- KV caches + sampler state are DONATED through the jitted step: no
+  per-token reallocation, the cache lives in HBM across the whole session.
+- host↔device traffic per step is [B] tokens + [B] logprobs out and [B]
+  bools in — a few hundred bytes.
+
+The host side owns: admission queue, stop sequences (with holdback so a
+half-matched stop string is never emitted), EOS/max-token termination,
+incremental UTF-8-safe detokenization, per-request output queues, and
+tokens/sec + TTFT metrics (GetMetrics parity —
+/root/reference/backend/backend.proto:40-46).
+"""
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from functools import partial
+from typing import Any, Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from localai_tpu.models.llama import (
+    LlamaConfig,
+    decode_step,
+    init_kv_cache,
+    prefill,
+)
+from localai_tpu.ops.rope import rope_table
+from localai_tpu.ops.sampling import (
+    SamplerState,
+    SamplingParams,
+    sample,
+    sampler_row,
+)
+from localai_tpu.parallel.mesh import activate_mesh
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Engine shape knobs (reference: n_parallel / n_ctx in ModelOptions,
+    /root/reference/backend/backend.proto:185-187,199)."""
+    max_slots: int = 4            # n_parallel — concurrent sequences
+    max_context: int = 1024       # n_ctx per slot
+    prefill_buckets: tuple[int, ...] = (64, 256, 1024)
+    dtype: str | None = None      # default: model dtype
+    mesh: Any | None = None       # jax.sharding.Mesh for TP/DP sharding
+
+
+@dataclasses.dataclass
+class GenRequest:
+    """One generation request (the PredictOptions surface that matters to the
+    engine; prompt templating/grammar happen upstream)."""
+    prompt_ids: list[int]
+    params: SamplingParams = dataclasses.field(default_factory=SamplingParams)
+    max_tokens: int = 128
+    stop: tuple[str, ...] = ()
+    ignore_eos: bool = False
+    logprobs: bool = False
+
+
+@dataclasses.dataclass
+class StepOutput:
+    """One streamed chunk."""
+    request_id: int
+    text: str                 # newly-stable text (may be "")
+    token_id: int
+    logprob: float
+    finished: bool
+    finish_reason: str | None = None   # stop | length | eos
+    generated_tokens: int = 0
+    prompt_tokens: int = 0
+
+
+@dataclasses.dataclass
+class _Slot:
+    request_id: int
+    req: GenRequest
+    out: queue.Queue
+    detok: Any                       # _IncrementalDecoder | None
+    pending_text: str = ""           # holdback buffer for stop-string scan
+    generated: int = 0
+    gen_ids: list[int] = dataclasses.field(default_factory=list)
+    start_time: float = 0.0
+    first_token_time: float | None = None
+    prompt_len: int = 0
+
+
+class Engine:
+    """Continuous-batching engine over one loaded model."""
+
+    def __init__(
+        self,
+        cfg: LlamaConfig,
+        params,
+        tokenizer=None,
+        econfig: EngineConfig | None = None,
+    ):
+        self.cfg = cfg
+        self.params = params
+        self.tok = tokenizer
+        self.ec = econfig or EngineConfig()
+        if self.ec.max_context > cfg.max_position:
+            raise ValueError("max_context exceeds model max_position")
+        for b in self.ec.prefill_buckets:
+            if b > self.ec.max_context:
+                raise ValueError("prefill bucket larger than max_context")
+
+        B, T, V = self.ec.max_slots, self.ec.max_context, cfg.vocab_size
+        dtype = jnp.dtype(self.ec.dtype) if self.ec.dtype else cfg.jdtype
+        self.mesh = self.ec.mesh
+
+        with activate_mesh(self.mesh):
+            cos, sin = rope_table(cfg.rope, T)
+            self._cos, self._sin = cos, sin
+            self._kc, self._vc = init_kv_cache(cfg, B, T, dtype)
+            self._sampler = SamplerState.init(B, V)
+            self._last_logits = jnp.zeros((B, V), jnp.float32)
+            self._lengths = jnp.zeros((B,), jnp.int32)
+
+        # host-side slot table
+        self._slots: list[_Slot | None] = [None] * B
+        self._free: list[int] = list(range(B))
+        self._queue: "queue.Queue[tuple[int, GenRequest, queue.Queue]]" = queue.Queue()
+        self._next_id = 0
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._running = False
+        self._thread: threading.Thread | None = None
+
+        # metrics (reference MetricsResponse: backend.proto:40-46)
+        self.metrics = {
+            "requests_completed": 0,
+            "tokens_generated": 0,
+            "prompt_tokens_processed": 0,
+            "ttft_ms_last": 0.0,
+            "tokens_per_second_last": 0.0,
+        }
+
+        self._build_jit()
+
+    # ------------------------------------------------------------ jit builds
+
+    def _build_jit(self):
+        cfg = self.cfg
+
+        def _admit(params, cos, sin, kc, vc, sampler, last_logits, lengths,
+                   tokens, length, slot, row, counts_row):
+            """Prefill one request into `slot` + install its sampler row."""
+            logits, kc, vc = prefill(
+                params, cfg, tokens, length[None], cos, sin, kc, vc, slot[None]
+            )
+            last_logits = last_logits.at[slot].set(logits[0])
+            lengths = lengths.at[slot].set(length)
+            new_fields = {}
+            for f in dataclasses.fields(SamplerState):
+                cur = getattr(sampler, f.name)
+                if f.name == "token_counts":
+                    new_fields[f.name] = cur.at[slot].set(counts_row)
+                else:
+                    new_fields[f.name] = cur.at[slot].set(row[f.name])
+            return kc, vc, SamplerState(**new_fields), last_logits, lengths
+
+        def _decode(params, cos, sin, kc, vc, sampler, last_logits, lengths,
+                    active):
+            """sample(prev logits) → decode → next logits, for all slots."""
+            tokens, keys, logprobs = sample(last_logits, sampler)
+            logits, kc, vc = decode_step(
+                params, cfg, tokens, lengths, cos, sin, kc, vc
+            )
+            act = active.astype(jnp.int32)
+            counts = sampler.token_counts.at[
+                jnp.arange(tokens.shape[0]), tokens
+            ].add(act)
+            sampler = dataclasses.replace(
+                sampler, key=keys, token_counts=counts
+            )
+            lengths = lengths + act
+            return tokens, logprobs, kc, vc, sampler, logits, lengths
+
+        # donate the big carried buffers: cache stays in place in HBM
+        self._admit_fn = jax.jit(_admit, donate_argnums=(3, 4, 5, 6, 7))
+        self._decode_fn = jax.jit(_decode, donate_argnums=(3, 4, 5, 6, 7))
+
+    # ------------------------------------------------------------ submission
+
+    def submit(self, req: GenRequest) -> tuple[int, queue.Queue]:
+        """Enqueue a request; returns (request_id, output queue of StepOutput)."""
+        if len(req.prompt_ids) == 0:
+            raise ValueError("empty prompt")
+        if len(req.prompt_ids) > max(self.ec.prefill_buckets):
+            raise ValueError(
+                f"prompt length {len(req.prompt_ids)} exceeds max prefill "
+                f"bucket {max(self.ec.prefill_buckets)}"
+            )
+        with self._lock:
+            rid = self._next_id
+            self._next_id += 1
+        out: queue.Queue = queue.Queue()
+        self._queue.put((rid, req, out))
+        self._wake.set()
+        return rid, out
+
+    # ------------------------------------------------------------ the loop
+
+    def _bucket(self, n: int) -> int:
+        for b in self.ec.prefill_buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"prompt too long: {n}")
+
+    def _admit_one(self, rid: int, req: GenRequest, out: queue.Queue):
+        slot = self._free.pop()
+        n = len(req.prompt_ids)
+        bucket = self._bucket(n)
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :n] = req.prompt_ids
+        counts_row = np.zeros((self.cfg.vocab_size,), np.int32)
+        pid, pcnt = np.unique(np.asarray(req.prompt_ids, np.int64), return_counts=True)
+        counts_row[pid] = pcnt
+        row = sampler_row(req.params, self.cfg.vocab_size, fallback_seed=rid + 1)
+
+        with activate_mesh(self.mesh):
+            (self._kc, self._vc, self._sampler, self._last_logits,
+             self._lengths) = self._admit_fn(
+                self.params, self._cos, self._sin,
+                self._kc, self._vc, self._sampler, self._last_logits,
+                self._lengths,
+                jnp.asarray(ids), jnp.int32(n), jnp.int32(slot),
+                row, jnp.asarray(counts_row),
+            )
+
+        self._slots[slot] = _Slot(
+            request_id=rid, req=req, out=out,
+            detok=self.tok.stream_decoder() if self.tok else None,
+            start_time=time.monotonic(), prompt_len=n,
+        )
+        self.metrics["prompt_tokens_processed"] += n
+
+    def _active_mask(self) -> np.ndarray:
+        return np.array([s is not None for s in self._slots], bool)
+
+    def step(self) -> bool:
+        """One engine iteration: admit waiting work, run one decode step.
+        Returns True if any slot is active after the step."""
+        # admission
+        while self._free:
+            try:
+                rid, req, out = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            self._admit_one(rid, req, out)
+
+        active = self._active_mask()
+        if not active.any():
+            return False
+
+        with activate_mesh(self.mesh):
+            (tokens, logprobs, self._kc, self._vc, self._sampler,
+             self._last_logits, self._lengths) = self._decode_fn(
+                self.params, self._cos, self._sin,
+                self._kc, self._vc, self._sampler, self._last_logits,
+                self._lengths, jnp.asarray(active),
+            )
+        tokens = np.asarray(jax.device_get(tokens))
+        logprobs = np.asarray(jax.device_get(logprobs))
+
+        now = time.monotonic()
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            self._emit(i, slot, int(tokens[i]), float(logprobs[i]), now)
+        return any(s is not None for s in self._slots)
+
+    def _emit(self, idx: int, slot: _Slot, token_id: int, logprob: float,
+              now: float):
+        if slot.first_token_time is None:
+            slot.first_token_time = now
+            self.metrics["ttft_ms_last"] = (now - slot.start_time) * 1e3
+        slot.generated += 1
+        slot.gen_ids.append(token_id)
+        self.metrics["tokens_generated"] += 1
+
+        finish = None
+        if (not slot.req.ignore_eos and self.tok is not None
+                and token_id in self.tok.eos_ids):
+            finish = "eos"
+        elif slot.generated >= slot.req.max_tokens:
+            finish = "length"
+        elif slot.prompt_len + slot.generated >= self.ec.max_context - 1:
+            finish = "length"
+
+        text = ""
+        if slot.detok is not None and finish != "eos":
+            text = slot.detok.push(token_id)
+
+        # stop-string scan with holdback
+        emit_text = text
+        if slot.req.stop:
+            slot.pending_text += text
+            hold = max(len(s) for s in slot.req.stop) - 1
+            matched = None
+            for s in slot.req.stop:
+                j = slot.pending_text.find(s)
+                if j != -1 and (matched is None or j < matched[0]):
+                    matched = (j, s)
+            if matched is not None:
+                emit_text = slot.pending_text[: matched[0]]
+                slot.pending_text = ""
+                finish = "stop"
+            elif finish is not None:
+                emit_text = slot.pending_text
+                slot.pending_text = ""
+            else:
+                stable = len(slot.pending_text) - hold
+                emit_text = slot.pending_text[:stable] if stable > 0 else ""
+                slot.pending_text = slot.pending_text[max(stable, 0):]
+
+        slot.out.put(StepOutput(
+            request_id=slot.request_id, text=emit_text, token_id=token_id,
+            logprob=logprob, finished=finish is not None, finish_reason=finish,
+            generated_tokens=slot.generated, prompt_tokens=slot.prompt_len,
+        ))
+        if finish is not None:
+            dur = now - slot.start_time
+            if dur > 0:
+                self.metrics["tokens_per_second_last"] = slot.generated / dur
+            self.metrics["requests_completed"] += 1
+            self._slots[idx] = None
+            self._free.append(idx)
+
+    # ------------------------------------------------------------ run modes
+
+    def start(self):
+        """Run the engine loop in a background thread (serving mode)."""
+        if self._running:
+            return
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._running = False
+        self._wake.set()
+        if self._thread:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def _loop(self):
+        while self._running:
+            busy = self.step()
+            if not busy:
+                self._wake.clear()
+                self._wake.wait(timeout=0.05)
+
+    def generate(self, req: GenRequest) -> Iterator[StepOutput]:
+        """Synchronous convenience: submit + drive the loop until finished.
+        Only valid when the background thread is NOT running."""
+        if self._running:
+            raise RuntimeError("use submit() while the engine loop is running")
+        rid, out = self.submit(req)
+        done = False
+        while not done:
+            self.step()
+            while True:
+                try:
+                    o = out.get_nowait()
+                except queue.Empty:
+                    break
+                yield o
+                if o.finished:
+                    done = True
+
+    def generate_text(self, req: GenRequest) -> str:
+        return "".join(o.text for o in self.generate(req))

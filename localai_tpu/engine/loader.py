@@ -1,0 +1,204 @@
+"""Checkpoint loading: HF safetensors → stacked JAX param pytree.
+
+Reference analog: `LoadModel` in the llama.cpp backend reads GGUF
+(/root/reference/backend/cpp/llama-cpp/grpc-server.cpp:505) and vLLM loads HF
+checkpoints (/root/reference/backend/python/vllm/backend.py:92-122). Here the
+on-disk format is HF safetensors (the TPU-ecosystem standard); tensors are
+read lazily per-shard, transposed into our [in, out] matmul layout, stacked
+on a leading layer axis (the lax.scan layout), and — when a mesh is given —
+placed directly as sharded jax.Arrays so a TP-sharded load never materializes
+the full model on one chip.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding
+
+from localai_tpu.models.llama import LlamaConfig, param_specs
+
+# HF architectures the Llama-family decoder covers (SURVEY §2.2 row 1 scope).
+LLAMA_FAMILY = {
+    "LlamaForCausalLM": {},
+    "MistralForCausalLM": {},
+    "Qwen2ForCausalLM": {"qkv_bias": True},
+    "TinyLlamaForCausalLM": {},
+}
+
+
+def load_config(model_dir: str, dtype: str | None = None) -> LlamaConfig:
+    """Parse HF config.json into a LlamaConfig. `dtype` overrides the compute
+    dtype (activations follow params; bf16 is the TPU default)."""
+    with open(os.path.join(model_dir, "config.json")) as f:
+        hf: dict[str, Any] = json.load(f)
+
+    arch = (hf.get("architectures") or ["LlamaForCausalLM"])[0]
+    if arch not in LLAMA_FAMILY:
+        raise ValueError(f"unsupported architecture {arch!r}")
+    extra = LLAMA_FAMILY[arch]
+
+    num_heads = hf["num_attention_heads"]
+    head_dim = hf.get("head_dim") or hf["hidden_size"] // num_heads
+
+    kw: dict[str, Any] = dict(
+        vocab_size=hf["vocab_size"],
+        hidden_size=hf["hidden_size"],
+        intermediate_size=hf["intermediate_size"],
+        num_layers=hf["num_hidden_layers"],
+        num_heads=num_heads,
+        num_kv_heads=hf.get("num_key_value_heads", num_heads),
+        head_dim=head_dim,
+        max_position=hf.get("max_position_embeddings", 8192),
+        rms_eps=hf.get("rms_norm_eps", 1e-5),
+        rope_base=hf.get("rope_theta", 10000.0),
+        tie_embeddings=hf.get("tie_word_embeddings", False),
+        sliding_window=hf.get("sliding_window"),
+        qkv_bias=hf.get("attention_bias", extra.get("qkv_bias", False)),
+    )
+    if dtype is not None:
+        kw["dtype"] = dtype
+
+    rs = hf.get("rope_scaling") or hf.get("rope_parameters") or None
+    if rs and isinstance(rs, dict) and rs.get("rope_type", rs.get("type")) not in (None, "default"):
+        rope_type = rs.get("rope_type", rs.get("type"))
+        kw["rope_scaling"] = rope_type
+        kw["rope_scale_factor"] = rs.get("factor", 1.0)
+        kw["rope_original_max_position"] = rs.get(
+            "original_max_position_embeddings", kw["max_position"]
+        )
+        if rope_type == "llama3":
+            kw["rope_low_freq_factor"] = rs.get("low_freq_factor", 1.0)
+            kw["rope_high_freq_factor"] = rs.get("high_freq_factor", 4.0)
+        if rope_type == "yarn":
+            kw["rope_beta_fast"] = rs.get("beta_fast", 32.0)
+            kw["rope_beta_slow"] = rs.get("beta_slow", 1.0)
+            kw["rope_attn_factor"] = rs.get("attention_factor")
+    return LlamaConfig(**kw)
+
+
+def _shard_index(model_dir: str) -> dict[str, str]:
+    """tensor name → safetensors filename (single-file or index.json layouts)."""
+    idx = os.path.join(model_dir, "model.safetensors.index.json")
+    if os.path.exists(idx):
+        with open(idx) as f:
+            return json.load(f)["weight_map"]
+    for name in ("model.safetensors",):
+        if os.path.exists(os.path.join(model_dir, name)):
+            from safetensors import safe_open
+
+            with safe_open(os.path.join(model_dir, name), framework="flax") as f:
+                return {k: name for k in f.keys()}
+    raise FileNotFoundError(f"no safetensors checkpoint in {model_dir}")
+
+
+class _TensorReader:
+    """Lazy per-tensor reads across safetensors shards (framework='flax'
+    handles bf16 natively — numpy can't)."""
+
+    def __init__(self, model_dir: str):
+        self.dir = model_dir
+        self.index = _shard_index(model_dir)
+        self._open: dict[str, Any] = {}
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.index
+
+    def get(self, name: str) -> jax.Array:
+        from safetensors import safe_open
+
+        fname = self.index[name]
+        if fname not in self._open:
+            self._open[fname] = safe_open(
+                os.path.join(self.dir, fname), framework="flax"
+            )
+        return self._open[fname].get_tensor(name)
+
+    def close(self):
+        self._open.clear()
+
+
+def load_params(
+    model_dir: str,
+    cfg: LlamaConfig,
+    *,
+    dtype=None,
+    mesh=None,
+):
+    """Load + restructure a HF Llama-family checkpoint.
+
+    HF stores projection weights as [out, in]; our matmuls are x @ W so every
+    projection is transposed once here, at load time. Per-layer tensors are
+    stacked on a leading [L, ...] axis to match the lax.scan execution layout
+    (models/llama.py init_params). With `mesh`, each stacked param is placed
+    as a NamedSharding'ed jax.Array per param_specs (Megatron-style TP).
+    """
+    dtype = jnp.dtype(dtype) if dtype is not None else cfg.jdtype
+    r = _TensorReader(model_dir)
+    specs = param_specs(cfg) if mesh is not None else None
+
+    def put(x, spec):
+        x = x.astype(dtype) if x.dtype != jnp.float32 or dtype != jnp.float32 else x
+        if mesh is not None:
+            return jax.device_put(x, NamedSharding(mesh, spec))
+        return x
+
+    def stack(fmt: str, transpose: bool):
+        ts = []
+        for i in range(cfg.num_layers):
+            t = r.get(fmt.format(i=i))
+            ts.append(t.T if transpose else t)
+        return jnp.stack(ts)
+
+    L = "model.layers.{i}."
+    layers = {
+        "attn_norm": stack(L + "input_layernorm.weight", False),
+        "wq": stack(L + "self_attn.q_proj.weight", True),
+        "wk": stack(L + "self_attn.k_proj.weight", True),
+        "wv": stack(L + "self_attn.v_proj.weight", True),
+        "wo": stack(L + "self_attn.o_proj.weight", True),
+        "mlp_norm": stack(L + "post_attention_layernorm.weight", False),
+        "w_gate": stack(L + "mlp.gate_proj.weight", True),
+        "w_up": stack(L + "mlp.up_proj.weight", True),
+        "w_down": stack(L + "mlp.down_proj.weight", True),
+    }
+    if cfg.qkv_bias:
+        layers["bq"] = stack(L + "self_attn.q_proj.bias", False)
+        layers["bk"] = stack(L + "self_attn.k_proj.bias", False)
+        layers["bv"] = stack(L + "self_attn.v_proj.bias", False)
+
+    lspecs = specs["layers"] if specs else {k: None for k in layers}
+    layers = {k: put(v, lspecs[k]) for k, v in layers.items()}
+
+    params = {
+        "embed": put(
+            r.get("model.embed_tokens.weight"), specs["embed"] if specs else None
+        ),
+        "layers": layers,
+        "final_norm": put(
+            r.get("model.norm.weight"), specs["final_norm"] if specs else None
+        ),
+    }
+    if not cfg.tie_embeddings:
+        name = "lm_head.weight"
+        if name not in r:
+            raise ValueError(
+                "config says untied embeddings but lm_head.weight is missing"
+            )
+        params["lm_head"] = put(r.get(name).T, specs["lm_head"] if specs else None)
+    r.close()
+    return params
+
+
+def load_model(model_dir: str, *, dtype=None, mesh=None):
+    """config.json + safetensors + tokenizer in one call → (cfg, params, tok)."""
+    from localai_tpu.engine.tokenizer import Tokenizer
+
+    cfg = load_config(model_dir, dtype=dtype)
+    params = load_params(model_dir, cfg, mesh=mesh)
+    tok = Tokenizer.from_dir(model_dir)
+    return cfg, params, tok

@@ -1,0 +1,180 @@
+"""Tokenizer: HF tokenizer.json + chat template + incremental detokenization.
+
+The reference delegates tokenization to each backend (llama.cpp's vocab;
+vLLM's HF tokenizer with chat template —
+/root/reference/backend/python/vllm/backend.py:242-243). We standardize on the
+`tokenizers` runtime (no transformers import in the serving path) with the
+chat template rendered by jinja2 from tokenizer_config.json.
+
+Incremental detokenization: byte-level BPE emits partial UTF-8 sequences at
+token boundaries; `StreamDecoder` holds bytes back until they form complete
+characters — the role of the rune-reassembly loop in the reference's Go core
+(/root/reference/core/backend/llm.go:114-144).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+from tokenizers import Tokenizer as _HFTokenizer
+
+# Fallback when tokenizer_config.json carries no chat template: the ubiquitous
+# [INST]-style template (functionally the reference's hardcoded llama2 default).
+_FALLBACK_TEMPLATE = (
+    "{% for message in messages %}"
+    "{% if message['role'] == 'system' %}<<SYS>>{{ message['content'] }}<</SYS>>\n"
+    "{% elif message['role'] == 'user' %}[INST] {{ message['content'] }} [/INST]"
+    "{% else %}{{ message['content'] }}{% endif %}"
+    "{% endfor %}"
+)
+
+
+class Tokenizer:
+    """Thin wrapper: encode/decode, special ids, chat template."""
+
+    def __init__(
+        self,
+        tok: _HFTokenizer,
+        *,
+        bos_id: int | None = None,
+        eos_ids: set[int] | None = None,
+        add_bos: bool = True,
+        chat_template: str | None = None,
+    ):
+        self._tok = tok
+        self.bos_id = bos_id
+        self.eos_ids = eos_ids or set()
+        self.add_bos = add_bos
+        self.chat_template = chat_template or _FALLBACK_TEMPLATE
+        self._jinja = None
+
+    # ------------------------------------------------------------ loading
+
+    @classmethod
+    def from_dir(cls, model_dir: str) -> "Tokenizer":
+        tok = _HFTokenizer.from_file(os.path.join(model_dir, "tokenizer.json"))
+        cfg: dict[str, Any] = {}
+        cfg_path = os.path.join(model_dir, "tokenizer_config.json")
+        if os.path.exists(cfg_path):
+            with open(cfg_path) as f:
+                cfg = json.load(f)
+
+        def _tok_str(v):
+            if isinstance(v, dict):
+                return v.get("content")
+            return v
+
+        bos = _tok_str(cfg.get("bos_token"))
+        eos = _tok_str(cfg.get("eos_token"))
+        bos_id = tok.token_to_id(bos) if bos else None
+        eos_ids = set()
+        if eos and tok.token_to_id(eos) is not None:
+            eos_ids.add(tok.token_to_id(eos))
+        # generation_config.json may add extra stop ids (llama3 <|eot_id|>)
+        gen_path = os.path.join(model_dir, "generation_config.json")
+        if os.path.exists(gen_path):
+            with open(gen_path) as f:
+                g = json.load(f)
+            e = g.get("eos_token_id")
+            for i in e if isinstance(e, list) else ([e] if e is not None else []):
+                eos_ids.add(int(i))
+        return cls(
+            tok,
+            bos_id=bos_id,
+            eos_ids=eos_ids,
+            add_bos=bool(cfg.get("add_bos_token", bos_id is not None)),
+            chat_template=cfg.get("chat_template"),
+        )
+
+    # ------------------------------------------------------------ encode/decode
+
+    @property
+    def vocab_size(self) -> int:
+        return self._tok.get_vocab_size()
+
+    def encode(self, text: str, *, add_bos: bool | None = None) -> list[int]:
+        ids = self._tok.encode(text, add_special_tokens=False).ids
+        add_bos = self.add_bos if add_bos is None else add_bos
+        if add_bos and self.bos_id is not None:
+            if not ids or ids[0] != self.bos_id:
+                ids = [self.bos_id] + ids
+        return ids
+
+    def decode(self, ids: list[int], *, skip_special: bool = True) -> str:
+        return self._tok.decode(list(ids), skip_special_tokens=skip_special)
+
+    def id_to_token(self, i: int) -> str | None:
+        return self._tok.id_to_token(i)
+
+    # ------------------------------------------------------------ chat template
+
+    def apply_chat_template(
+        self,
+        messages: list[dict[str, Any]],
+        *,
+        add_generation_prompt: bool = True,
+        tools: list | None = None,
+    ) -> str:
+        if self._jinja is None:
+            import jinja2
+
+            env = jinja2.Environment(
+                trim_blocks=True, lstrip_blocks=True,
+                extensions=["jinja2.ext.loopcontrols"],
+            )
+            env.globals["raise_exception"] = _raise_exception
+            env.filters["tojson"] = json.dumps
+            self._jinja = env.from_string(self.chat_template)
+        bos = self.id_to_token(self.bos_id) if self.bos_id is not None else ""
+        eos = next(iter(self.eos_ids), None)
+        return self._jinja.render(
+            messages=messages,
+            tools=tools,
+            add_generation_prompt=add_generation_prompt,
+            bos_token=bos or "",
+            eos_token=self.id_to_token(eos) if eos is not None else "",
+        )
+
+    def encode_chat(self, messages, **kw) -> list[int]:
+        text = self.apply_chat_template(messages, **kw)
+        # chat templates typically embed the BOS token themselves
+        explicit_bos = self.bos_id is not None and text.startswith(
+            self.id_to_token(self.bos_id) or "\x00"
+        )
+        return self.encode(text, add_bos=not explicit_bos)
+
+    def stream_decoder(self) -> "_IncrementalDecoder":
+        return _IncrementalDecoder(self)
+
+
+class _IncrementalDecoder:
+    """Stateful decode: emits only newly-completed text per pushed token."""
+
+    def __init__(self, tok: Tokenizer):
+        self._tok = tok
+        self._ids: list[int] = []
+        self._done = 0        # ids fully represented in _text
+        self._text = ""       # text emitted so far for ids[:_done]
+
+    def push(self, token_id: int) -> str:
+        self._ids.append(token_id)
+        pending = self._ids[self._done:]
+        text = self._tok.decode(pending)
+        if text.endswith("�"):
+            return ""  # incomplete multi-byte char; wait for more tokens
+        self._done = len(self._ids)
+        self._text += text
+        return text
+
+    @property
+    def text(self) -> str:
+        return self._text
+
+    @property
+    def ids(self) -> list[int]:
+        return list(self._ids)
+
+
+def _raise_exception(msg):
+    raise ValueError(msg)

@@ -49,6 +49,7 @@ class LlamaConfig:
     rope_high_freq_factor: float = 4.0
     rope_beta_fast: float = 32.0
     rope_beta_slow: float = 1.0
+    rope_attn_factor: float | None = None
     qkv_bias: bool = False              # Qwen2
     tie_embeddings: bool = False
     sliding_window: int | None = None   # Mistral
@@ -66,6 +67,7 @@ class LlamaConfig:
             high_freq_factor=self.rope_high_freq_factor,
             beta_fast=self.rope_beta_fast,
             beta_slow=self.rope_beta_slow,
+            attn_factor=self.rope_attn_factor,
         )
 
     @property

@@ -25,7 +25,7 @@ class RopeConfig:
     # yarn
     beta_fast: float = 32.0
     beta_slow: float = 1.0
-    attn_factor: float = 1.0
+    attn_factor: float | None = None   # HF attention_factor; None → computed
     # llama3
     low_freq_factor: float = 1.0
     high_freq_factor: float = 4.0
@@ -63,14 +63,23 @@ def rope_freqs(cfg: RopeConfig):
     elif cfg.scaling == "yarn":
         lo = max(math.floor(_yarn_find_dim(cfg.beta_fast, cfg.head_dim, cfg.base,
                                            cfg.original_max_position)), 0)
+        # HF clamps the upper correction bound to head_dim-1 (NOT half-1), and
+        # guards a collapsed range with +0.001 — mirror both exactly.
         hi = min(math.ceil(_yarn_find_dim(cfg.beta_slow, cfg.head_dim, cfg.base,
-                                          cfg.original_max_position)), half - 1)
-        ramp = jnp.clip((jnp.arange(half, dtype=jnp.float32) - lo) / max(hi - lo, 1), 0.0, 1.0)
+                                          cfg.original_max_position)),
+                 cfg.head_dim - 1)
+        if hi == lo:
+            hi += 0.001
+        ramp = jnp.clip((jnp.arange(half, dtype=jnp.float32) - lo) / (hi - lo), 0.0, 1.0)
         # extrapolate (keep original freq) below lo, interpolate (1/scale) above
         # hi, blend in between — matches HF _compute_yarn_parameters where
         # extrapolation_factor = 1 - ramp.
         inv_freq = inv_freq / cfg.scale_factor * ramp + inv_freq * (1.0 - ramp)
-        mscale = cfg.attn_factor * (0.1 * math.log(cfg.scale_factor) + 1.0) if cfg.scale_factor > 1 else 1.0
+        # HF: a provided attention_factor is used VERBATIM; otherwise computed
+        if cfg.attn_factor is not None:
+            mscale = cfg.attn_factor
+        elif cfg.scale_factor > 1:
+            mscale = 0.1 * math.log(cfg.scale_factor) + 1.0
     elif cfg.scaling != "none":
         raise ValueError(f"unknown rope scaling mode {cfg.scaling!r}")
 

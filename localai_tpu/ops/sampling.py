@@ -50,9 +50,11 @@ class SamplingParams:
         return p
 
 
+@jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class SamplerState:
-    """Device-side batched sampler state, one row per engine slot."""
+    """Device-side batched sampler state, one row per engine slot (a pytree —
+    flows through jit with buffer donation)."""
     temperature: jax.Array   # [B] f32
     top_k: jax.Array         # [B] i32 (0 = off)
     top_p: jax.Array         # [B] f32
@@ -84,28 +86,33 @@ class SamplerState:
             logit_bias=jnp.zeros((batch, vocab), jnp.float32),
         )
 
-    def slot_row(self, params: SamplingParams, vocab: int, slot_seed: int):
-        """Host-side: build the row values for writing one slot (see engine)."""
-        p = params.normalized()
-        bias = jnp.zeros((vocab,), jnp.float32)
-        if p.logit_bias:
-            ids = jnp.array(list(p.logit_bias.keys()), jnp.int32)
-            vals = jnp.array(list(p.logit_bias.values()), jnp.float32)
-            bias = bias.at[ids].set(vals)
-        seed = p.seed if p.seed is not None and p.seed >= 0 else slot_seed
-        return dict(
-            temperature=jnp.float32(p.temperature),
-            top_k=jnp.int32(min(p.top_k, vocab)),
-            top_p=jnp.float32(p.top_p),
-            min_p=jnp.float32(p.min_p),
-            typical_p=jnp.float32(p.typical_p),
-            repeat_penalty=jnp.float32(p.repeat_penalty),
-            presence_penalty=jnp.float32(p.presence_penalty),
-            frequency_penalty=jnp.float32(p.frequency_penalty),
-            greedy=jnp.bool_(p.greedy),
-            key=jax.random.key_data(jax.random.PRNGKey(seed)).astype(jnp.uint32),
-            logit_bias=bias,
-        )
+
+def sampler_row(params: SamplingParams, vocab: int, fallback_seed: int) -> dict:
+    """Host-side: build the per-slot row values (everything except
+    token_counts, which the engine fills with prompt occurrence counts).
+    `fallback_seed` is used when the request doesn't pin a seed."""
+    import numpy as np
+
+    p = params.normalized()
+    bias = np.zeros((vocab,), np.float32)
+    if p.logit_bias:
+        for k, v in p.logit_bias.items():
+            if 0 <= int(k) < vocab:
+                bias[int(k)] = v
+    seed = p.seed if (p.seed is not None and p.seed >= 0) else fallback_seed
+    return dict(
+        temperature=jnp.float32(p.temperature),
+        top_k=jnp.int32(min(p.top_k, vocab)),
+        top_p=jnp.float32(p.top_p),
+        min_p=jnp.float32(p.min_p),
+        typical_p=jnp.float32(p.typical_p),
+        repeat_penalty=jnp.float32(p.repeat_penalty),
+        presence_penalty=jnp.float32(p.presence_penalty),
+        frequency_penalty=jnp.float32(p.frequency_penalty),
+        greedy=jnp.bool_(p.greedy),
+        key=jax.random.key_data(jax.random.PRNGKey(seed)).astype(jnp.uint32),
+        logit_bias=jnp.asarray(bias),
+    )
 
 
 def apply_penalties(logits, state: SamplerState):

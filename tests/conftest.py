@@ -39,7 +39,6 @@ def devices():
 @pytest.fixture(scope="session")
 def mesh8():
     """2x4 ('data','model') mesh over the virtual CPU devices."""
-    import numpy as np
-    from jax.sharding import Mesh
+    from localai_tpu.parallel import MeshConfig, build_mesh
 
-    return Mesh(np.array(jax.devices()).reshape(2, 4), ("data", "model"))
+    return build_mesh(MeshConfig(data=2, model=4))

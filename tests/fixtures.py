@@ -1,0 +1,84 @@
+"""Shared test fixtures: a tiny REAL HF Llama checkpoint built locally.
+
+Zero-egress environment → we can't download TinyLlama; instead we construct a
+genuine transformers LlamaForCausalLM (random weights), save it as safetensors
+with a trained byte-level BPE tokenizer, and treat that directory as the
+checkpoint under test. Parity tests compare our engine against the HF forward
+pass on the same weights — the same guarantee a downloaded model would give.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+CORPUS = [
+    "the quick brown fox jumps over the lazy dog",
+    "hello world, this is a test of the tokenizer",
+    "TPU native inference with JAX and XLA collectives",
+    "pack my box with five dozen liquor jugs",
+    "sphinx of black quartz judge my vow",
+    "números y acentos: café, naïve, über, 東京",
+]
+
+CHAT_TEMPLATE = (
+    "{{ bos_token }}{% for message in messages %}"
+    "<|{{ message['role'] }}|>\n{{ message['content'] }}</s>\n"
+    "{% endfor %}"
+    "{% if add_generation_prompt %}<|assistant|>\n{% endif %}"
+)
+
+
+def build_tiny_checkpoint(dirpath: str, *, vocab_size: int = 384,
+                          hidden: int = 64, layers: int = 2, heads: int = 4,
+                          kv_heads: int = 2, inter: int = 128,
+                          tie: bool = False, seed: int = 0) -> str:
+    """Create a tiny HF Llama checkpoint + tokenizer at `dirpath`."""
+    import torch
+    from tokenizers import Tokenizer, models, pre_tokenizers, decoders, trainers
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    os.makedirs(dirpath, exist_ok=True)
+
+    tok = Tokenizer(models.BPE(unk_token=None))
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tok.decoder = decoders.ByteLevel()
+    trainer = trainers.BpeTrainer(
+        vocab_size=vocab_size - 4,
+        special_tokens=["<s>", "</s>", "<|user|>", "<|assistant|>"],
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
+        show_progress=False,
+    )
+    tok.train_from_iterator(CORPUS * 4, trainer=trainer)
+    real_vocab = tok.get_vocab_size()
+    tok.save(os.path.join(dirpath, "tokenizer.json"))
+    with open(os.path.join(dirpath, "tokenizer_config.json"), "w") as f:
+        json.dump({
+            "bos_token": "<s>", "eos_token": "</s>",
+            "add_bos_token": True,
+            "chat_template": CHAT_TEMPLATE,
+        }, f)
+
+    torch.manual_seed(seed)
+    cfg = LlamaConfig(
+        vocab_size=real_vocab, hidden_size=hidden, intermediate_size=inter,
+        num_hidden_layers=layers, num_attention_heads=heads,
+        num_key_value_heads=kv_heads, max_position_embeddings=256,
+        rms_norm_eps=1e-5, rope_theta=10000.0, tie_word_embeddings=tie,
+        bos_token_id=0, eos_token_id=1,
+    )
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    model.save_pretrained(dirpath, safe_serialization=True)
+    return dirpath
+
+
+_CACHE = {}
+
+
+def tiny_checkpoint(tmp_path_factory, **kw) -> str:
+    """Session-cached tiny checkpoint (building one takes a few seconds)."""
+    key = tuple(sorted(kw.items()))
+    if key not in _CACHE:
+        d = tmp_path_factory.mktemp("tinyllama")
+        _CACHE[key] = build_tiny_checkpoint(str(d), **kw)
+    return _CACHE[key]

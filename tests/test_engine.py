@@ -1,0 +1,183 @@
+"""End-to-end engine tests against a real (locally built) HF checkpoint.
+
+This is the round-2 "one real model talks" milestone (VERDICT next-round #1):
+checkpoint loading parity with HF, greedy decode parity with HF generate, and
+concurrent streaming with per-request sampling params.
+"""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from localai_tpu.engine import (
+    Engine, EngineConfig, GenRequest, Tokenizer, load_config, load_params,
+)
+from localai_tpu.models.llama import forward_train
+from localai_tpu.ops.sampling import SamplingParams
+
+from fixtures import tiny_checkpoint
+
+
+@pytest.fixture(scope="session")
+def ckpt(tmp_path_factory):
+    return tiny_checkpoint(tmp_path_factory)
+
+
+@pytest.fixture(scope="session")
+def loaded(ckpt):
+    cfg = load_config(ckpt, dtype="float32")
+    params = load_params(ckpt, cfg)
+    tok = Tokenizer.from_dir(ckpt)
+    return cfg, params, tok
+
+
+def _hf_model(ckpt):
+    import torch
+    from transformers import LlamaForCausalLM
+
+    m = LlamaForCausalLM.from_pretrained(ckpt, torch_dtype=torch.float32)
+    m.eval()
+    return m
+
+
+def test_config_parsed(loaded):
+    cfg, _, tok = loaded
+    assert cfg.num_kv_heads == 2 and cfg.num_layers == 2
+    assert cfg.vocab_size == tok.vocab_size
+
+
+def test_logits_parity_with_hf(ckpt, loaded):
+    """Our forward on loaded safetensors == HF forward on the same weights."""
+    import torch
+
+    cfg, params, tok = loaded
+    ids = tok.encode("the quick brown fox jumps over the lazy dog")
+    hf = _hf_model(ckpt)
+    with torch.no_grad():
+        ref = hf(torch.tensor([ids])).logits[0].numpy()
+    ours = np.asarray(forward_train(params, cfg, jnp.asarray([ids])))[0]
+    np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_greedy_generate_matches_hf(ckpt, loaded):
+    import torch
+
+    cfg, params, tok = loaded
+    prompt = tok.encode("hello world")
+    n_new = 12
+
+    hf = _hf_model(ckpt)
+    with torch.no_grad():
+        out = hf.generate(
+            torch.tensor([prompt]), max_new_tokens=n_new, do_sample=False,
+            eos_token_id=None, pad_token_id=0,
+            # explicit mask: generate() would otherwise infer one from
+            # pad_token_id and mask out our BOS (id 0)
+            attention_mask=torch.ones((1, len(prompt)), dtype=torch.long),
+        )[0].tolist()
+    ref_new = out[len(prompt):]
+
+    eng = Engine(cfg, params, tok, EngineConfig(max_slots=2, max_context=128,
+                                                prefill_buckets=(32, 128)))
+    req = GenRequest(prompt_ids=prompt, params=SamplingParams(temperature=0.0),
+                     max_tokens=n_new, ignore_eos=True)
+    toks = [o.token_id for o in eng.generate(req)]
+    assert toks == ref_new
+
+
+def test_concurrent_streams_with_different_sampling(loaded):
+    """2+ requests in flight with different sampling params stream to
+    completion and produce the prompt-conditioned text deterministically
+    for the greedy one."""
+    cfg, params, tok = loaded
+    eng = Engine(cfg, params, tok, EngineConfig(max_slots=3, max_context=128,
+                                                prefill_buckets=(32,)))
+    reqs = [
+        GenRequest(tok.encode("pack my box"), SamplingParams(temperature=0.0),
+                   max_tokens=8, ignore_eos=True),
+        GenRequest(tok.encode("sphinx of black"),
+                   SamplingParams(temperature=0.9, top_k=20, seed=7),
+                   max_tokens=8, ignore_eos=True),
+        GenRequest(tok.encode("hello"),
+                   SamplingParams(temperature=0.7, top_p=0.9, seed=3),
+                   max_tokens=8, ignore_eos=True),
+    ]
+    outs = [eng.submit(r) for r in reqs]
+    # drive the loop manually until all finish
+    for _ in range(200):
+        if not eng.step():
+            break
+    results = {}
+    for rid, q in outs:
+        text, n = "", 0
+        while not q.empty():
+            o = q.get()
+            text += o.text
+            n = o.generated_tokens
+            if o.finished:
+                results[rid] = (text, n, o.finish_reason)
+    assert len(results) == 3
+    for text, n, reason in results.values():
+        assert n == 8 and reason == "length"
+
+    # greedy request must reproduce the single-request greedy output
+    solo = Engine(cfg, params, tok, EngineConfig(max_slots=1, max_context=128,
+                                                 prefill_buckets=(32,)))
+    ref = solo.generate_text(reqs[0])
+    assert results[outs[0][0]][0] == ref
+
+
+def test_stop_sequence_truncates(loaded):
+    cfg, params, tok = loaded
+    eng = Engine(cfg, params, tok, EngineConfig(max_slots=1, max_context=128,
+                                                prefill_buckets=(32,)))
+    # run greedy once to find a substring the model actually emits
+    base = eng.generate_text(GenRequest(
+        tok.encode("the quick"), SamplingParams(temperature=0.0),
+        max_tokens=10, ignore_eos=True))
+    assert len(base) > 3
+    stop = base[2:5]
+    eng2 = Engine(cfg, params, tok, EngineConfig(max_slots=1, max_context=128,
+                                                 prefill_buckets=(32,)))
+    outs = list(eng2.generate(GenRequest(
+        tok.encode("the quick"), SamplingParams(temperature=0.0),
+        max_tokens=10, ignore_eos=True, stop=(stop,))))
+    text = "".join(o.text for o in outs)
+    assert stop not in text
+    assert outs[-1].finish_reason == "stop"
+    assert text == base[:base.find(stop)]
+
+
+def test_penalties_affect_output(loaded):
+    """repeat penalty must change sampling behavior (token_counts is live)."""
+    cfg, params, tok = loaded
+    ec = EngineConfig(max_slots=1, max_context=128, prefill_buckets=(32,))
+    prompt = tok.encode("hello world hello world hello")
+
+    def run(rp):
+        eng = Engine(cfg, params, tok, ec)
+        return [o.token_id for o in eng.generate(GenRequest(
+            prompt, SamplingParams(temperature=0.0, repeat_penalty=rp),
+            max_tokens=10, ignore_eos=True))]
+
+    assert run(1.0) != run(5.0)
+
+
+def test_chat_template(loaded):
+    _, _, tok = loaded
+    text = tok.apply_chat_template(
+        [{"role": "user", "content": "hi"}], add_generation_prompt=True
+    )
+    assert "<|user|>" in text and text.endswith("<|assistant|>\n")
+    ids = tok.encode_chat([{"role": "user", "content": "hi"}])
+    assert ids[0] == tok.bos_id
+
+
+def test_incremental_detok_utf8(loaded):
+    """Multi-byte characters split across tokens must never emit U+FFFD."""
+    _, _, tok = loaded
+    s = "café 東京 über"
+    ids = tok.encode(s, add_bos=False)
+    dec = tok.stream_decoder()
+    text = "".join(dec.push(i) for i in ids)
+    assert "�" not in text
+    assert text == tok.decode(ids)

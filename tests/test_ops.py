@@ -5,7 +5,7 @@ import numpy as np
 from localai_tpu.ops.norms import rms_norm
 from localai_tpu.ops.rope import RopeConfig, rope_table, apply_rope
 from localai_tpu.ops.attention import mha_prefill, mha_decode
-from localai_tpu.ops.sampling import SamplerState, SamplingParams, sample
+from localai_tpu.ops.sampling import SamplerState, SamplingParams, sample, sampler_row
 
 
 def test_rms_norm():
@@ -75,10 +75,10 @@ def test_mha_decode_matches_prefill_last_row():
 def test_sampling_greedy_and_topk():
     B, V = 2, 50
     st = SamplerState.init(B, V)
-    row = st.slot_row(SamplingParams(temperature=0.0), V, slot_seed=7)
+    row = sampler_row(SamplingParams(temperature=0.0), V, fallback_seed=7)
     for f, val in row.items():
         setattr(st, f, getattr(st, f).at[0].set(val))
-    row1 = st.slot_row(SamplingParams(temperature=1.0, top_k=1, seed=3), V, 0)
+    row1 = sampler_row(SamplingParams(temperature=1.0, top_k=1, seed=3), V, 0)
     for f, val in row1.items():
         setattr(st, f, getattr(st, f).at[1].set(val))
     logits = jnp.zeros((B, V)).at[:, 17].set(10.0)
@@ -90,7 +90,7 @@ def test_sampling_greedy_and_topk():
 def test_sampling_penalties_suppress_repeats():
     B, V = 1, 16
     st = SamplerState.init(B, V)
-    row = st.slot_row(SamplingParams(temperature=0.0, repeat_penalty=2.0), V, 0)
+    row = sampler_row(SamplingParams(temperature=0.0, repeat_penalty=2.0), V, 0)
     for f, val in row.items():
         setattr(st, f, getattr(st, f).at[0].set(val))
     st.token_counts = st.token_counts.at[0, 5].set(3)

@@ -71,6 +71,45 @@ def test_llama3_matches_hf():
     np.testing.assert_allclose(np.asarray(ours), theirs, rtol=1e-6)
 
 
+def test_yarn_long_context_clamp_matches_hf():
+    """original_max_position large enough that the upper correction bound
+    exceeds head_dim//2 — HF clamps to head_dim-1, a round-1 divergence."""
+    scaling = {
+        "rope_type": "yarn",
+        "factor": 4.0,
+        "beta_fast": 32.0,
+        "beta_slow": 1.0,
+        "original_max_position_embeddings": 131072,
+    }
+    ours, _ = rope_freqs(
+        RopeConfig(
+            head_dim=128, base=10000.0, scaling="yarn", scale_factor=4.0,
+            original_max_position=131072, beta_fast=32.0, beta_slow=1.0,
+        )
+    )
+    theirs, _ = _hf_freqs("yarn", 128, 10000.0, 131072, scaling)
+    np.testing.assert_allclose(np.asarray(ours), theirs, rtol=1e-5)
+
+
+def test_yarn_explicit_attention_factor_used_verbatim():
+    scaling = {
+        "rope_type": "yarn",
+        "factor": 4.0,
+        "beta_fast": 32.0,
+        "beta_slow": 1.0,
+        "attention_factor": 0.9,
+        "original_max_position_embeddings": 4096,
+    }
+    _, mscale = rope_freqs(
+        RopeConfig(
+            head_dim=128, base=10000.0, scaling="yarn", scale_factor=4.0,
+            original_max_position=4096, attn_factor=0.9,
+        )
+    )
+    _, hf_mscale = _hf_freqs("yarn", 128, 10000.0, 4096, scaling)
+    assert mscale == pytest.approx(hf_mscale) == 0.9
+
+
 def test_yarn_matches_hf():
     scaling = {
         "rope_type": "yarn",
