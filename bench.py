@@ -1,0 +1,177 @@
+"""Serving benchmark — the measured answer to BASELINE.md (reference publishes
+no numbers; protocol = median of >=5 timed windows after warmup).
+
+Measures the continuous-batching Engine end-to-end on whatever accelerator is
+attached (one TPU chip under the driver; CPU with --cpu for local runs):
+steady-state decode throughput with all slots busy, p50 TTFT through the
+prefill bucket, and MFU derived from the model's FLOPs/token.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+vs_baseline is value / 1000 tok/s/chip — the BASELINE.md north star.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+import time
+
+
+def flagship_config(size: str):
+    from localai_tpu.models.llama import LlamaConfig
+
+    if size == "tiny":  # CPU smoke config
+        return LlamaConfig(vocab_size=512, hidden_size=128,
+                           intermediate_size=256, num_layers=2, num_heads=4,
+                           num_kv_heads=2, head_dim=32, max_position=512,
+                           tie_embeddings=True, dtype="float32")
+    if size == "1b":  # Llama-3.2-1B geometry
+        return LlamaConfig(vocab_size=128256, hidden_size=2048,
+                           intermediate_size=8192, num_layers=16, num_heads=32,
+                           num_kv_heads=8, head_dim=64, max_position=4096,
+                           rope_base=500000.0, tie_embeddings=True,
+                           dtype="bfloat16")
+    if size == "3b":  # Llama-3.2-3B geometry
+        return LlamaConfig(vocab_size=128256, hidden_size=3072,
+                           intermediate_size=8192, num_layers=28, num_heads=24,
+                           num_kv_heads=8, head_dim=128, max_position=4096,
+                           rope_base=500000.0, tie_embeddings=True,
+                           dtype="bfloat16")
+    raise ValueError(size)
+
+
+def param_count(cfg) -> int:
+    h, i, L, v = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers, cfg.vocab_size
+    qk = cfg.num_heads * cfg.head_dim
+    kv = cfg.num_kv_heads * cfg.head_dim
+    per_layer = h * qk + 2 * h * kv + qk * h + 3 * h * i + 2 * h
+    return v * h * (1 if cfg.tie_embeddings else 2) + L * per_layer + h
+
+
+def peak_flops_per_chip() -> float:
+    """bf16 peak for the attached accelerator (v5e 197 TF/s, v6e 918;
+    CPU: nominal 100 GF/s so MFU stays meaningful in smoke runs)."""
+    import jax
+
+    d = jax.devices()[0]
+    kind = getattr(d, "device_kind", "cpu").lower()
+    if "v6" in kind:
+        return 918e12
+    if "v5p" in kind:
+        return 459e12
+    if "v5" in kind:
+        return 197e12
+    if "v4" in kind:
+        return 275e12
+    if "cpu" in kind or d.platform == "cpu":
+        return 100e9
+    return 197e12
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--size", default=None, help="tiny|1b|3b (default: by platform)")
+    p.add_argument("--cpu", action="store_true", help="force CPU (local smoke)")
+    p.add_argument("--slots", type=int, default=8)
+    p.add_argument("--prompt-len", type=int, default=120)
+    p.add_argument("--decode-steps", type=int, default=128)
+    p.add_argument("--windows", type=int, default=5)
+    p.add_argument("--context", type=int, default=1024)
+    args = p.parse_args(argv)
+
+    def note(msg):
+        print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    note("initializing device client...")
+    dev = jax.devices()[0]
+    on_cpu = dev.platform == "cpu"
+    size = args.size or ("tiny" if on_cpu else "1b")
+
+    import numpy as np
+
+    from localai_tpu.engine import Engine, EngineConfig, GenRequest
+    from localai_tpu.models.llama import init_params
+    from localai_tpu.ops.sampling import SamplingParams
+
+    note(f"device={getattr(dev, 'device_kind', dev.platform)} size={size}")
+    cfg = flagship_config(size)
+    context = min(args.context, cfg.max_position)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    jax.block_until_ready(params)
+    note("params initialized")
+
+    eng = Engine(cfg, params, None, EngineConfig(
+        max_slots=args.slots, max_context=context,
+        prefill_buckets=(128, min(512, context)),
+    ))
+    rng = np.random.default_rng(0)
+
+    def req(n_tokens):
+        return GenRequest(
+            prompt_ids=rng.integers(1, cfg.vocab_size, args.prompt_len).tolist(),
+            params=SamplingParams(temperature=0.8, top_k=40, seed=int(rng.integers(1 << 30))),
+            max_tokens=n_tokens, ignore_eos=True)
+
+    # --- warmup: compile prefill bucket + decode step, run a few tokens
+    t0 = time.perf_counter()
+    for _ in range(args.slots):
+        eng.submit(req(4))
+    while eng.step():
+        pass
+    note(f"warmup (compile) done in {time.perf_counter() - t0:.1f}s")
+
+    # --- TTFT: submit one request into the idle engine, time to first token
+    ttfts = []
+    for _ in range(args.windows):
+        rid, out = eng.submit(req(2))
+        t0 = time.perf_counter()
+        while out.empty():
+            eng.step()
+        ttfts.append((time.perf_counter() - t0) * 1e3)
+        while eng.step():
+            pass
+    ttft_ms = statistics.median(ttfts)
+    note(f"ttft done: {ttft_ms:.1f}ms")
+
+    # --- steady-state decode: all slots busy for the whole window
+    tput = []
+    for _ in range(args.windows):
+        for _ in range(args.slots):
+            eng.submit(req(args.decode_steps))
+        while not all(s is not None for s in eng._slots):
+            eng.step()
+        n0 = eng.metrics["tokens_generated"]
+        t0 = time.perf_counter()
+        # time only fully-batched steps
+        steps = max(1, args.decode_steps - 8)
+        for _ in range(steps):
+            eng.step()
+        dt = time.perf_counter() - t0
+        tput.append((eng.metrics["tokens_generated"] - n0) / dt)
+        while eng.step():
+            pass
+    toks_per_s = statistics.median(tput)
+
+    n_params = param_count(cfg)
+    mfu = (toks_per_s * 2 * n_params) / peak_flops_per_chip()
+
+    print(json.dumps({
+        "metric": f"decode tok/s/chip (llama-{size}, {args.slots} slots, ctx {context})",
+        "value": round(toks_per_s, 2),
+        "unit": "tok/s",
+        "vs_baseline": round(toks_per_s / 1000.0, 4),
+        "ttft_p50_ms": round(ttft_ms, 2),
+        "mfu": round(mfu, 4),
+        "device": getattr(dev, "device_kind", dev.platform),
+        "params": n_params,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,9 +1,9 @@
 """CLI entrypoint — the `local-ai` role (reference: core/cli/cli.go:8-21).
 
-Subcommands mirror the reference surface: `run` (default, serve HTTP),
-`backend` (run one gRPC backend process), `models` (list/install), `tts`,
-`transcribe`, `bench`. Implemented with argparse; flags use the same names as
-the reference's kong flags (core/cli/run.go:24-77) where they map 1:1.
+Subcommands mirror the reference surface: `run` (serve HTTP), `backend` (run
+one gRPC backend process), `models` (list/install), `version`; invoking with
+no subcommand prints help. Implemented with argparse; flags use the same names
+as the reference's kong flags (core/cli/run.go:24-77) where they map 1:1.
 """
 from __future__ import annotations
 

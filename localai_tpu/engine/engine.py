@@ -142,6 +142,7 @@ class Engine:
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._running = False
+        self._dead = False
         self._thread: threading.Thread | None = None
 
         # metrics (reference MetricsResponse: backend.proto:40-46)
@@ -202,6 +203,8 @@ class Engine:
 
     def submit(self, req: GenRequest) -> tuple[int, queue.Queue]:
         """Enqueue a request; returns (request_id, output queue of StepOutput)."""
+        if self._dead:
+            raise RuntimeError("engine loop has terminated; no new requests")
         if len(req.prompt_ids) == 0:
             raise ValueError("empty prompt")
         if len(req.prompt_ids) > max(self.ec.prefill_buckets):
@@ -209,6 +212,9 @@ class Engine:
                 f"prompt length {len(req.prompt_ids)} exceeds max prefill "
                 f"bucket {max(self.ec.prefill_buckets)}"
             )
+        V = self.cfg.vocab_size
+        if any(not (0 <= t < V) for t in req.prompt_ids):
+            raise ValueError(f"prompt token id outside [0, {V})")
         with self._lock:
             rid = self._next_id
             self._next_id += 1
@@ -307,8 +313,11 @@ class Engine:
             finish = "length"
 
         text = ""
-        if slot.detok is not None and finish != "eos":
-            text = slot.detok.push(token_id)
+        if slot.detok is not None:
+            if finish != "eos":
+                text = slot.detok.push(token_id)
+            if finish is not None:
+                text += slot.detok.flush()
 
         # stop-string scan with holdback
         emit_text = text
@@ -352,19 +361,59 @@ class Engine:
         if self._running:
             return
         self._running = True
+        self._dead = False
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
     def stop(self):
+        was_serving = self._thread is not None
         self._running = False
+        self._dead = True
         self._wake.set()
         if self._thread:
-            self._thread.join(timeout=10)
+            self._thread.join(timeout=30)
+            if self._thread.is_alive():
+                # thread stuck (e.g. mid-compile): do NOT reclaim slots it may
+                # still touch — consumers see the engine as dead via submit()
+                return
             self._thread = None
+        if was_serving:
+            self._fail_active("cancelled")
+
+    def _fail_active(self, reason: str):
+        """Send a terminal StepOutput to every in-flight slot + queued request
+        so no consumer blocks forever on its output queue."""
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            slot.out.put(StepOutput(
+                request_id=slot.request_id, text="", token_id=-1, logprob=0.0,
+                finished=True, finish_reason=reason,
+                generated_tokens=slot.generated, prompt_tokens=slot.prompt_len,
+            ))
+            self._slots[i] = None
+            self._free.append(i)
+        while True:
+            try:
+                rid, req, out = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            out.put(StepOutput(request_id=rid, text="", token_id=-1,
+                               logprob=0.0, finished=True,
+                               finish_reason=reason))
 
     def _loop(self):
         while self._running:
-            busy = self.step()
+            try:
+                busy = self.step()
+            except Exception:  # device OOM, compile failure, ...
+                import traceback
+
+                traceback.print_exc()
+                self._running = False
+                self._dead = True
+                self._fail_active("error")
+                return
             if not busy:
                 self._wake.clear()
                 self._wake.wait(timeout=0.05)

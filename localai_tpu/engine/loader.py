@@ -81,44 +81,87 @@ def load_config(model_dir: str, dtype: str | None = None) -> LlamaConfig:
     return LlamaConfig(**kw)
 
 
-def _shard_index(model_dir: str) -> dict[str, str]:
-    """tensor name → safetensors filename (single-file or index.json layouts)."""
-    idx = os.path.join(model_dir, "model.safetensors.index.json")
-    if os.path.exists(idx):
-        with open(idx) as f:
-            return json.load(f)["weight_map"]
-    for name in ("model.safetensors",):
-        if os.path.exists(os.path.join(model_dir, name)):
-            from safetensors import safe_open
+class _SafetensorsFile:
+    """Minimal host-side safetensors reader: 8-byte header length, JSON header
+    {name: {dtype, shape, data_offsets}}, then raw little-endian tensor data.
+    mmap + np.frombuffer keeps every tensor on HOST memory (bf16 via ml_dtypes)
+    so a TP-sharded load never materializes the full model on one chip —
+    unlike framework-mode safe_open, which commits to the default device.
+    """
 
-            with safe_open(os.path.join(model_dir, name), framework="flax") as f:
-                return {k: name for k in f.keys()}
-    raise FileNotFoundError(f"no safetensors checkpoint in {model_dir}")
+    _DTYPES = {
+        "F64": np.float64, "F32": np.float32, "F16": np.float16,
+        "I64": np.int64, "I32": np.int32, "I16": np.int16, "I8": np.int8,
+        "U8": np.uint8, "BOOL": np.bool_,
+    }
+
+    def __init__(self, path: str):
+        import mmap
+
+        import ml_dtypes
+
+        self._DTYPES = dict(self._DTYPES)
+        self._DTYPES["BF16"] = ml_dtypes.bfloat16
+        self._f = open(path, "rb")
+        self._mm = mmap.mmap(self._f.fileno(), 0, access=mmap.ACCESS_READ)
+        (hlen,) = np.frombuffer(self._mm[:8], np.uint64)
+        self._header: dict[str, Any] = json.loads(self._mm[8 : 8 + int(hlen)])
+        self._header.pop("__metadata__", None)
+        self._base = 8 + int(hlen)
+
+    def keys(self):
+        return self._header.keys()
+
+    def get(self, name: str) -> np.ndarray:
+        meta = self._header[name]
+        lo, hi = meta["data_offsets"]
+        arr = np.frombuffer(
+            self._mm[self._base + lo : self._base + hi],
+            self._DTYPES[meta["dtype"]],
+        )
+        return arr.reshape(meta["shape"])
+
+    def close(self):
+        self._mm.close()
+        self._f.close()
 
 
 class _TensorReader:
-    """Lazy per-tensor reads across safetensors shards (framework='flax'
-    handles bf16 natively — numpy can't)."""
+    """Lazy per-tensor host reads across safetensors shards."""
 
     def __init__(self, model_dir: str):
         self.dir = model_dir
-        self.index = _shard_index(model_dir)
-        self._open: dict[str, Any] = {}
+        self.index = self._shard_index(model_dir)
+        self._open: dict[str, _SafetensorsFile] = {}
+
+    @staticmethod
+    def _shard_index(model_dir: str) -> dict[str, str]:
+        """tensor name → safetensors filename (single-file or index.json)."""
+        idx = os.path.join(model_dir, "model.safetensors.index.json")
+        if os.path.exists(idx):
+            with open(idx) as f:
+                return json.load(f)["weight_map"]
+        name = "model.safetensors"
+        if os.path.exists(os.path.join(model_dir, name)):
+            f = _SafetensorsFile(os.path.join(model_dir, name))
+            try:
+                return {k: name for k in f.keys()}
+            finally:
+                f.close()
+        raise FileNotFoundError(f"no safetensors checkpoint in {model_dir}")
 
     def __contains__(self, name: str) -> bool:
         return name in self.index
 
-    def get(self, name: str) -> jax.Array:
-        from safetensors import safe_open
-
+    def get(self, name: str) -> np.ndarray:
         fname = self.index[name]
         if fname not in self._open:
-            self._open[fname] = safe_open(
-                os.path.join(self.dir, fname), framework="flax"
-            )
-        return self._open[fname].get_tensor(name)
+            self._open[fname] = _SafetensorsFile(os.path.join(self.dir, fname))
+        return self._open[fname].get(name)
 
     def close(self):
+        for f in self._open.values():
+            f.close()
         self._open.clear()
 
 
@@ -142,17 +185,18 @@ def load_params(
     specs = param_specs(cfg) if mesh is not None else None
 
     def put(x, spec):
-        x = x.astype(dtype) if x.dtype != jnp.float32 or dtype != jnp.float32 else x
+        # host numpy → cast on host → single device_put (sharded when meshed)
+        x = x if x.dtype == dtype else x.astype(dtype)
         if mesh is not None:
             return jax.device_put(x, NamedSharding(mesh, spec))
-        return x
+        return jnp.asarray(x)
 
     def stack(fmt: str, transpose: bool):
         ts = []
         for i in range(cfg.num_layers):
             t = r.get(fmt.format(i=i))
             ts.append(t.T if transpose else t)
-        return jnp.stack(ts)
+        return np.stack(ts)
 
     L = "model.layers.{i}."
     layers = {

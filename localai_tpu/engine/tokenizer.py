@@ -41,10 +41,12 @@ class Tokenizer:
         eos_ids: set[int] | None = None,
         add_bos: bool = True,
         chat_template: str | None = None,
+        eos_token: str | None = None,
     ):
         self._tok = tok
         self.bos_id = bos_id
         self.eos_ids = eos_ids or set()
+        self.eos_token = eos_token
         self.add_bos = add_bos
         self.chat_template = chat_template or _FALLBACK_TEMPLATE
         self._jinja = None
@@ -85,6 +87,7 @@ class Tokenizer:
             eos_ids=eos_ids,
             add_bos=bool(cfg.get("add_bos_token", bos_id is not None)),
             chat_template=cfg.get("chat_template"),
+            eos_token=eos,
         )
 
     # ------------------------------------------------------------ encode/decode
@@ -127,13 +130,12 @@ class Tokenizer:
             env.filters["tojson"] = json.dumps
             self._jinja = env.from_string(self.chat_template)
         bos = self.id_to_token(self.bos_id) if self.bos_id is not None else ""
-        eos = next(iter(self.eos_ids), None)
         return self._jinja.render(
             messages=messages,
             tools=tools,
             add_generation_prompt=add_generation_prompt,
             bos_token=bos or "",
-            eos_token=self.id_to_token(eos) if eos is not None else "",
+            eos_token=self.eos_token or "",
         )
 
     def encode_chat(self, messages, **kw) -> list[int]:
@@ -149,23 +151,48 @@ class Tokenizer:
 
 
 class _IncrementalDecoder:
-    """Stateful decode: emits only newly-completed text per pushed token."""
+    """Stateful decode: emits only newly-completed text per pushed token.
+
+    Sliding two-offset window (the vLLM detokenize_incrementally scheme): the
+    delta is `decode(ids[prefix:]) - decode(ids[prefix:read])`, so tokenizers
+    whose decoders strip a leading word-boundary space per call (SentencePiece
+    Metaspace — Llama-2/Mistral) still produce correct inter-word spaces; a
+    suffix ending in an incomplete UTF-8 sequence is held back until complete.
+    """
 
     def __init__(self, tok: Tokenizer):
         self._tok = tok
         self._ids: list[int] = []
-        self._done = 0        # ids fully represented in _text
-        self._text = ""       # text emitted so far for ids[:_done]
+        self._prefix = 0      # token index where the decode window starts
+        self._read = 0        # tokens fully represented in _text
+        self._text = ""
+
+    def _window(self) -> tuple[str, str]:
+        prefix_text = self._tok.decode(self._ids[self._prefix:self._read])
+        full_text = self._tok.decode(self._ids[self._prefix:])
+        return prefix_text, full_text
 
     def push(self, token_id: int) -> str:
         self._ids.append(token_id)
-        pending = self._ids[self._done:]
-        text = self._tok.decode(pending)
-        if text.endswith("�"):
+        prefix_text, full_text = self._window()
+        if full_text.endswith("�"):
             return ""  # incomplete multi-byte char; wait for more tokens
-        self._done = len(self._ids)
-        self._text += text
-        return text
+        delta = full_text[len(prefix_text):]
+        self._prefix = self._read
+        self._read = len(self._ids)
+        self._text += delta
+        return delta
+
+    def flush(self) -> str:
+        """Emit whatever is still held back (incomplete sequences included) —
+        called when a request finishes so no trailing text is lost."""
+        if self._read == len(self._ids):
+            return ""
+        prefix_text, full_text = self._window()
+        delta = full_text[len(prefix_text):]
+        self._prefix = self._read = len(self._ids)
+        self._text += delta
+        return delta
 
     @property
     def text(self) -> str:

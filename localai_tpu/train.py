@@ -1,0 +1,42 @@
+"""Minimal training step over the flagship decoder.
+
+The reference is inference-only (SURVEY §5 "Checkpoint / resume"), but the
+TPU-native framework keeps a real train step for fine-tuning and for the
+driver's multi-chip dry-run: data-parallel batch over the 'data' mesh axis,
+Megatron-style tensor parallelism over 'model' (param_specs), XLA inserting
+the psum/all-gather collectives.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from localai_tpu.models.llama import LlamaConfig, forward_train
+from localai_tpu.parallel.mesh import constrain
+from jax.sharding import PartitionSpec as P
+
+
+def causal_lm_loss(params, cfg: LlamaConfig, tokens):
+    """Next-token cross-entropy over a [B, S] batch (mean over real tokens)."""
+    tokens = constrain(tokens, P("data", None))
+    logits = forward_train(params, cfg, tokens[:, :-1])
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
+def make_train_step(cfg: LlamaConfig, optimizer: optax.GradientTransformation):
+    """Returns train_step(params, opt_state, tokens) -> (params, opt_state, loss).
+    jit it under an active mesh; params sharded per param_specs; batch on 'data'."""
+
+    def train_step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(causal_lm_loss)(params, cfg, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return train_step

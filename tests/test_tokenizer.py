@@ -1,0 +1,59 @@
+"""Incremental detokenization across tokenizer decoder families.
+
+The streaming path must reproduce tok.decode(ids) exactly for both ByteLevel
+BPE (GPT/Llama-3 style) and Metaspace (SentencePiece/Llama-2 style, whose
+decoder strips the leading word-boundary space on every decode call — the
+classic dropped-space streaming bug).
+"""
+import pytest
+
+from localai_tpu.engine.tokenizer import Tokenizer
+
+
+def _metaspace_tokenizer():
+    from tokenizers import Tokenizer as HFTok
+    from tokenizers import decoders, models, pre_tokenizers, trainers
+
+    tok = HFTok(models.BPE(unk_token="<unk>"))
+    tok.pre_tokenizer = pre_tokenizers.Metaspace()
+    tok.decoder = decoders.Metaspace()
+    trainer = trainers.BpeTrainer(
+        vocab_size=200, special_tokens=["<unk>", "<s>", "</s>"],
+        show_progress=False,
+    )
+    corpus = ["hello world this is a test", "the quick brown fox",
+              "pack my box with five dozen jugs"] * 4
+    tok.train_from_iterator(corpus, trainer=trainer)
+    return Tokenizer(tok, bos_id=1, eos_ids={2})
+
+
+def test_metaspace_streaming_keeps_spaces():
+    tok = _metaspace_tokenizer()
+    s = "hello world this is the quick fox"
+    ids = tok.encode(s, add_bos=False)
+    ref = tok.decode(ids)
+    assert " " in ref  # sanity: multi-word
+    dec = tok.stream_decoder()
+    streamed = "".join(dec.push(i) for i in ids) + dec.flush()
+    assert streamed == ref
+
+
+def test_flush_emits_heldback_bytes():
+    """A generation that ends mid-UTF-8-sequence must still flush the tail."""
+    import json
+    import os
+    import sys
+    import tempfile
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from fixtures import build_tiny_checkpoint
+
+    d = tempfile.mkdtemp()
+    build_tiny_checkpoint(d)
+    tok = Tokenizer.from_dir(d)
+    ids = tok.encode("café 東京", add_bos=False)
+    # push all but the final token of a multi-byte char: delta held back
+    dec = tok.stream_decoder()
+    out = "".join(dec.push(i) for i in ids[:-1])
+    tail = dec.flush()
+    assert out + tail == tok.decode(ids[:-1])
