@@ -1,0 +1,10 @@
+"""gRPC backend processes — the L1/L2 process boundary.
+
+One proto contract (`backend.proto`), many backend roles (llm, embedding,
+whisper, store, ...), each a separate process spawned by the control plane on
+a localhost port (reference: /root/reference/pkg/model/process.go:93-160).
+"""
+from localai_tpu.backend import pb  # noqa: F401
+from localai_tpu.backend.base import BackendServicer  # noqa: F401
+from localai_tpu.backend.client import BackendClient  # noqa: F401
+from localai_tpu.backend.server import serve, serve_blocking  # noqa: F401

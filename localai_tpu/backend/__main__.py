@@ -1,0 +1,17 @@
+"""`python -m localai_tpu.backend --addr 127.0.0.1:PORT --backend llm`"""
+import argparse
+import sys
+
+from localai_tpu.backend.server import ROLES, serve_blocking
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="localai_tpu.backend")
+    p.add_argument("--addr", default="127.0.0.1:50051")
+    p.add_argument("--backend", default="llm", choices=sorted(ROLES))
+    args = p.parse_args(argv)
+    return serve_blocking(addr=args.addr, backend=args.backend)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,119 @@
+"""Typed gRPC client for the Backend contract — the control-plane side
+(reference: /root/reference/pkg/grpc/client.go:53-519, one wrapper per RPC,
+plus spawn-time health polling initializers.go:110-129).
+
+No generated stubs (no grpc_tools in image): callables are derived from the
+proto DESCRIPTOR, same wire format.
+"""
+from __future__ import annotations
+
+import time
+from typing import Iterator
+
+import grpc
+
+from localai_tpu.backend import pb
+
+
+class BackendClient:
+    def __init__(self, addr: str):
+        self.addr = addr
+        self._channel = grpc.insecure_channel(addr)
+        self._calls = {}
+        sym = pb._pb2
+        for m in pb.SERVICE.methods:
+            req_cls = getattr(sym, m.input_type.name)
+            resp_cls = getattr(sym, m.output_type.name)
+            make = (self._channel.unary_stream if m.server_streaming
+                    else self._channel.unary_unary)
+            self._calls[m.name] = make(
+                f"/{pb.SERVICE_NAME}/{m.name}",
+                request_serializer=req_cls.SerializeToString,
+                response_deserializer=resp_cls.FromString,
+            )
+
+    def close(self):
+        self._channel.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # ------------------------------------------------------------ health
+
+    def health(self, timeout: float = 5.0) -> bool:
+        try:
+            r = self._calls["Health"](pb.HealthMessage(), timeout=timeout)
+            return r.message == b"OK"
+        except grpc.RpcError:
+            return False
+
+    def wait_ready(self, attempts: int = 60, sleep: float = 0.5) -> bool:
+        """Spawn-time health poll (reference initializers.go:110-129)."""
+        for _ in range(attempts):
+            if self.health(timeout=2.0):
+                return True
+            time.sleep(sleep)
+        return False
+
+    # ------------------------------------------------------------ RPCs
+
+    def load_model(self, timeout: float = 600.0, **kw) -> "pb.Result":
+        return self._calls["LoadModel"](pb.ModelOptions(**kw), timeout=timeout)
+
+    def predict(self, timeout: float = 600.0, **kw) -> "pb.Reply":
+        return self._calls["Predict"](pb.PredictOptions(**kw), timeout=timeout)
+
+    def predict_stream(self, timeout: float = 600.0, **kw) -> Iterator["pb.Reply"]:
+        return self._calls["PredictStream"](pb.PredictOptions(**kw),
+                                            timeout=timeout)
+
+    def embedding(self, timeout: float = 600.0, **kw) -> "pb.EmbeddingResult":
+        return self._calls["Embedding"](pb.PredictOptions(**kw), timeout=timeout)
+
+    def tokenize(self, prompt: str, timeout: float = 60.0) -> "pb.TokenizationResponse":
+        return self._calls["TokenizeString"](pb.PredictOptions(prompt=prompt),
+                                             timeout=timeout)
+
+    def rerank(self, timeout: float = 600.0, **kw) -> "pb.RerankResult":
+        return self._calls["Rerank"](pb.RerankRequest(**kw), timeout=timeout)
+
+    def status(self, timeout: float = 10.0) -> "pb.StatusResponse":
+        return self._calls["Status"](pb.HealthMessage(), timeout=timeout)
+
+    def metrics(self, timeout: float = 10.0) -> dict:
+        r = self._calls["GetMetrics"](pb.MetricsRequest(), timeout=timeout)
+        return dict(r.metrics)
+
+    def tts(self, timeout: float = 600.0, **kw) -> "pb.Result":
+        return self._calls["TTS"](pb.TTSRequest(**kw), timeout=timeout)
+
+    def transcribe(self, timeout: float = 600.0, **kw) -> "pb.TranscriptResult":
+        return self._calls["AudioTranscription"](pb.TranscriptRequest(**kw),
+                                                 timeout=timeout)
+
+    def vad(self, audio, timeout: float = 600.0) -> "pb.VADResponse":
+        return self._calls["VAD"](pb.VADRequest(audio=audio), timeout=timeout)
+
+    def generate_image(self, timeout: float = 600.0, **kw) -> "pb.Result":
+        return self._calls["GenerateImage"](pb.GenerateImageRequest(**kw),
+                                            timeout=timeout)
+
+    def stores_set(self, keys, values, timeout: float = 60.0) -> "pb.Result":
+        return self._calls["StoresSet"](pb.StoresSetOptions(
+            keys=[pb.StoresKey(floats=k) for k in keys],
+            values=[pb.StoresValue(bytes=v) for v in values]), timeout=timeout)
+
+    def stores_get(self, keys, timeout: float = 60.0) -> "pb.StoresGetResult":
+        return self._calls["StoresGet"](pb.StoresGetOptions(
+            keys=[pb.StoresKey(floats=k) for k in keys]), timeout=timeout)
+
+    def stores_delete(self, keys, timeout: float = 60.0) -> "pb.Result":
+        return self._calls["StoresDelete"](pb.StoresDeleteOptions(
+            keys=[pb.StoresKey(floats=k) for k in keys]), timeout=timeout)
+
+    def stores_find(self, key, top_k: int, timeout: float = 60.0) -> "pb.StoresFindResult":
+        return self._calls["StoresFind"](pb.StoresFindOptions(
+            key=pb.StoresKey(floats=key), top_k=top_k), timeout=timeout)

@@ -1,0 +1,232 @@
+"""The flagship LLM backend servicer — the llama.cpp-grpc-server role
+(/root/reference/backend/cpp/llama-cpp/grpc-server.cpp:505,571,1003,1373,1552),
+re-built over the TPU engine: LoadModel reads HF safetensors into (optionally
+mesh-sharded) jax.Arrays, Predict/PredictStream drive the continuous-batching
+Engine, Embedding runs the bucketed pooled encoder.
+"""
+from __future__ import annotations
+
+import json
+import os
+import resource
+import threading
+import time
+
+import grpc
+
+from localai_tpu.backend import pb
+from localai_tpu.backend.base import BackendServicer
+from localai_tpu.ops.sampling import SamplingParams
+
+
+class LLMServicer(BackendServicer):
+    def __init__(self):
+        self.engine = None
+        self.embedder = None
+        self.tok = None
+        self.cfg = None
+        self.model_name = ""
+        self._state = pb.StatusResponse.UNINITIALIZED
+        self._load_lock = threading.Lock()
+
+    # ------------------------------------------------------------ lifecycle
+
+    def LoadModel(self, request, context):
+        with self._load_lock:
+            if self.engine is not None:
+                return pb.Result(success=True, message="already loaded")
+            self._state = pb.StatusResponse.BUSY
+            try:
+                self._load(request)
+                self._state = pb.StatusResponse.READY
+                return pb.Result(success=True, message="ok")
+            except Exception as e:  # surface load errors to the control plane
+                self._state = pb.StatusResponse.ERROR
+                return pb.Result(success=False, message=f"{type(e).__name__}: {e}")
+
+    def _load(self, request):
+        import jax
+
+        from localai_tpu.engine import Engine, EngineConfig
+        from localai_tpu.engine.loader import load_config, load_params
+        from localai_tpu.engine.tokenizer import Tokenizer
+        from localai_tpu.engine.embedder import Embedder
+        from localai_tpu.models.llama import max_model_axis
+        from localai_tpu.parallel.mesh import MeshConfig, build_mesh
+
+        model_dir = request.model
+        if request.model_path and not os.path.isdir(model_dir):
+            model_dir = os.path.join(request.model_path, request.model)
+        if not os.path.isdir(model_dir):
+            raise FileNotFoundError(f"model directory not found: {model_dir}")
+
+        cfg = load_config(model_dir, dtype=request.dtype or None)
+        devices = jax.devices()
+        mesh = None
+        if request.mesh_data or request.mesh_model:
+            # explicit mesh request: honor it (invalid shapes fail loudly)
+            data = request.mesh_data or 1
+            model = request.mesh_model or (len(devices) // data)
+            mesh = build_mesh(MeshConfig(data=data, model=model),
+                              devices[: data * model])
+        elif len(devices) > 1:
+            # auto-TP over as many devices as the model dims divide into
+            model = max_model_axis(cfg, len(devices))
+            if model > 1:
+                mesh = build_mesh(MeshConfig(data=1, model=model),
+                                  devices[:model])
+
+        params = load_params(model_dir, cfg, mesh=mesh)
+        tok = Tokenizer.from_dir(model_dir)
+        context_size = request.context_size or min(2048, cfg.max_position)
+        buckets = tuple(request.prefill_buckets) or tuple(
+            b for b in (64, 256, 1024, 4096) if b <= context_size
+        ) or (context_size,)
+        self.engine = Engine(cfg, params, tok, EngineConfig(
+            max_slots=request.parallel or 4,
+            max_context=context_size,
+            prefill_buckets=buckets,
+            mesh=mesh,
+        ))
+        if request.embeddings:
+            self.embedder = Embedder(cfg, params, buckets=buckets, mesh=mesh)
+        self.cfg, self.tok = cfg, tok
+        self.model_name = request.model
+        self.engine.start()
+
+    # ------------------------------------------------------------ helpers
+
+    def _require_engine(self, context):
+        if self.engine is None:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                          "no model loaded (call LoadModel first)")
+
+    def _prompt_ids(self, request, context) -> list[int]:
+        if request.prompt_ids:
+            return list(request.prompt_ids)
+        if self.tok is None:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                          "no tokenizer; pass prompt_ids")
+        if request.use_tokenizer_template and request.messages_json:
+            messages = json.loads(request.messages_json)
+            return self.tok.encode_chat(messages)
+        return self.tok.encode(request.prompt)
+
+    @staticmethod
+    def _sampling(request) -> SamplingParams:
+        return SamplingParams(
+            temperature=request.temperature,
+            top_k=request.top_k or 0,
+            top_p=request.top_p or 1.0,
+            min_p=request.min_p,
+            typical_p=request.typical_p or 1.0,
+            repeat_penalty=request.repeat_penalty or 1.0,
+            presence_penalty=request.presence_penalty,
+            frequency_penalty=request.frequency_penalty,
+            seed=request.seed if request.seed else -1,
+            logit_bias=dict(request.logit_bias) or None,
+        )
+
+    def _submit(self, request, context):
+        from localai_tpu.engine import GenRequest
+
+        ids = self._prompt_ids(request, context)
+        req = GenRequest(
+            prompt_ids=ids,
+            params=self._sampling(request),
+            max_tokens=request.tokens or 128,
+            stop=tuple(request.stop_prompts),
+            ignore_eos=request.ignore_eos,
+            logprobs=request.logprobs,
+        )
+        try:
+            return self.engine.submit(req)
+        except (ValueError, RuntimeError) as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+
+    # ------------------------------------------------------------ inference
+
+    def Predict(self, request, context):
+        self._require_engine(context)
+        t0 = time.monotonic()
+        rid, out = self._submit(request, context)
+        text, ids, logprobs, ttft = [], [], [], 0.0
+        o = None
+        while True:
+            o = out.get()
+            if o.token_id >= 0 and not ttft:
+                ttft = time.monotonic() - t0
+            if o.text:
+                text.append(o.text)
+            if o.token_id >= 0:
+                ids.append(o.token_id)
+                logprobs.append(o.logprob)
+            if o.finished:
+                break
+        return pb.Reply(
+            message="".join(text).encode(),
+            tokens=o.generated_tokens,
+            prompt_tokens=o.prompt_tokens,
+            timing_prompt_processing=ttft,
+            timing_token_generation=time.monotonic() - t0 - ttft,
+            logprobs=logprobs if request.logprobs else [],
+            token_ids=ids,
+            finish_reason=o.finish_reason or "",
+        )
+
+    def PredictStream(self, request, context):
+        self._require_engine(context)
+        t0 = time.monotonic()
+        rid, out = self._submit(request, context)
+        ttft = 0.0
+        while True:
+            o = out.get()
+            if o.token_id >= 0 and not ttft:
+                ttft = time.monotonic() - t0
+            yield pb.Reply(
+                message=o.text.encode(),
+                tokens=o.generated_tokens,
+                prompt_tokens=o.prompt_tokens,
+                timing_prompt_processing=ttft if o.finished else 0.0,
+                timing_token_generation=(time.monotonic() - t0 - ttft)
+                if o.finished else 0.0,
+                logprobs=[o.logprob] if request.logprobs and o.token_id >= 0 else [],
+                token_ids=[o.token_id] if o.token_id >= 0 else [],
+                finish_reason=o.finish_reason or "",
+            )
+            if o.finished:
+                return
+
+    # ------------------------------------------------------------ aux RPCs
+
+    def TokenizeString(self, request, context):
+        if self.tok is None:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, "no tokenizer")
+        ids = self.tok.encode(request.prompt)
+        return pb.TokenizationResponse(length=len(ids), tokens=ids)
+
+    def Embedding(self, request, context):
+        if self.embedder is None:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                          "model loaded without embeddings=true")
+        ids = self._prompt_ids(request, context)
+        try:
+            vec = self.embedder.embed([ids])[0]
+        except ValueError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        return pb.EmbeddingResult(embeddings=vec.tolist())
+
+    def Status(self, request, context):
+        rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+        return pb.StatusResponse(
+            state=self._state,
+            memory=pb.MemoryUsageData(total=rss, breakdown={"rss_peak": rss}),
+        )
+
+    def GetMetrics(self, request, context):
+        m = dict(self.engine.metrics) if self.engine else {}
+        return pb.MetricsResponse(metrics={k: float(v) for k, v in m.items()})
+
+    def shutdown(self):
+        if self.engine is not None:
+            self.engine.stop()

@@ -1,0 +1,21 @@
+"""Import shim for the protoc-generated message module.
+
+protoc's --python_out emits `backend_pb2` expecting itself on sys.path; this
+re-exports it as `localai_tpu.backend.pb` so the package namespace stays clean.
+Regenerate with:
+  protoc --python_out=localai_tpu/backend -I localai_tpu/backend \
+      localai_tpu/backend/backend.proto
+"""
+import os
+import sys
+
+_here = os.path.dirname(__file__)
+if _here not in sys.path:
+    sys.path.insert(0, _here)
+
+from backend_pb2 import *  # noqa: F401,F403,E402
+import backend_pb2 as _pb2  # noqa: E402
+
+DESCRIPTOR = _pb2.DESCRIPTOR
+SERVICE = DESCRIPTOR.services_by_name["Backend"]
+SERVICE_NAME = SERVICE.full_name

@@ -1,0 +1,77 @@
+"""Backend process entrypoint: one gRPC server on a localhost port.
+
+Spawn contract mirrors the reference's backend launch
+(`--addr 127.0.0.1:<freeport>`, health-polled by the loader —
+/root/reference/pkg/model/initializers.go:57-129): the control plane starts
+`python -m localai_tpu.backend --addr ... --backend llm`, polls Health, then
+issues LoadModel.
+"""
+from __future__ import annotations
+
+import signal
+import threading
+from concurrent import futures
+
+import grpc
+
+from localai_tpu.backend.base import BackendServicer, add_backend_servicer
+
+# role registry — the backend zoo (reference SURVEY §2.2/2.3 rows); roles are
+# lazy imports so a store-only process never touches jax.
+ROLES = {}
+
+
+def _role(name):
+    def reg(fn):
+        ROLES[name] = fn
+        return fn
+
+    return reg
+
+
+@_role("llm")
+def _make_llm():
+    from localai_tpu.backend.llm import LLMServicer
+
+    return LLMServicer()
+
+
+@_role("base")
+def _make_base():
+    return BackendServicer()
+
+
+def serve(addr: str = "127.0.0.1:50051", backend: str = "llm",
+          max_workers: int = 16):
+    """Start a backend server; returns (grpc.Server, servicer, bound_port)."""
+    if backend not in ROLES:
+        raise ValueError(f"unknown backend role {backend!r}; have {sorted(ROLES)}")
+    servicer = ROLES[backend]()
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers),
+        options=[("grpc.max_receive_message_length", 128 * 1024 * 1024),
+                 ("grpc.max_send_message_length", 128 * 1024 * 1024)],
+    )
+    add_backend_servicer(server, servicer)
+    port = server.add_insecure_port(addr)
+    if port == 0:
+        raise OSError(f"could not bind {addr}")
+    server.start()
+    return server, servicer, port
+
+
+def serve_blocking(addr: str = "127.0.0.1:50051", backend: str = "llm") -> int:
+    server, servicer, port = serve(addr, backend)
+    print(f"backend[{backend}] serving on port {port}", flush=True)
+    stop = threading.Event()
+
+    def _sig(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _sig)
+    signal.signal(signal.SIGINT, _sig)
+    stop.wait()
+    if hasattr(servicer, "shutdown"):
+        servicer.shutdown()
+    server.stop(grace=5).wait(10)
+    return 0

@@ -1,0 +1,52 @@
+"""Embeddings runner: bucketed, jitted masked-mean pooling over the decoder.
+
+Reference analog: the transformers backend's Embedding RPC with mean_pooling
+(/root/reference/backend/python/transformers/backend.py:323,37). TPU-first:
+prompts are padded to a small set of length buckets so each shape compiles
+once; batch requests share one compiled call.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from localai_tpu.models.llama import LlamaConfig, encode_pooled
+from localai_tpu.parallel.mesh import activate_mesh
+
+
+class Embedder:
+    def __init__(self, cfg: LlamaConfig, params, *,
+                 buckets: tuple[int, ...] = (64, 256, 1024), mesh=None):
+        self.cfg = cfg
+        self.params = params
+        self.buckets = tuple(sorted(b for b in buckets
+                                    if b <= cfg.max_position)) or (64,)
+        self.mesh = mesh
+        self._fn = jax.jit(partial(encode_pooled, cfg=cfg))
+
+    def _bucket(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError(
+            f"input length {n} exceeds max embedding bucket {self.buckets[-1]}"
+        )
+
+    def embed(self, ids_batch: list[list[int]]) -> np.ndarray:
+        """[N] token-id lists → [N, H] f32 L2-normalized embeddings."""
+        if not ids_batch:
+            return np.zeros((0, self.cfg.hidden_size), np.float32)
+        longest = max(len(ids) for ids in ids_batch)
+        bucket = self._bucket(max(longest, 1))
+        toks = np.zeros((len(ids_batch), bucket), np.int32)
+        lens = np.zeros((len(ids_batch),), np.int32)
+        for i, ids in enumerate(ids_batch):
+            toks[i, : len(ids)] = ids
+            lens[i] = len(ids)
+        with activate_mesh(self.mesh):
+            out = self._fn(self.params, tokens=jnp.asarray(toks),
+                           lengths=jnp.asarray(lens))
+        return np.asarray(jax.device_get(out))

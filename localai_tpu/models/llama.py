@@ -143,6 +143,23 @@ def param_specs(cfg: LlamaConfig):
     return specs
 
 
+def max_model_axis(cfg: LlamaConfig, n_devices: int) -> int:
+    """Largest divisor of n_devices usable as the TP ('model') mesh axis: it
+    must divide every dimension param_specs/kv_cache_spec shard on it."""
+    dims = [
+        cfg.num_heads * cfg.head_dim,
+        cfg.num_kv_heads * cfg.head_dim,
+        cfg.intermediate_size,
+        cfg.num_kv_heads,  # kv cache shards the head axis
+    ]
+    if not cfg.tie_embeddings:
+        dims.append(cfg.vocab_size)  # vocab-parallel lm_head
+    for d in range(n_devices, 0, -1):
+        if n_devices % d == 0 and all(dim % d == 0 for dim in dims):
+            return d
+    return 1
+
+
 def kv_cache_spec():
     """KV cache [L, B, T, KVH, D]: slots on `data`, kv heads on `model`."""
     return P(None, "data", None, "model", None)
@@ -262,28 +279,55 @@ def decode_step(params, cfg: LlamaConfig, tokens, lengths, cos, sin,
     return logits, k_cache, v_cache
 
 
-def forward_train(params, cfg: LlamaConfig, tokens):
-    """Full-sequence causal forward → logits [B, S, V] (training / eval path)."""
+def hidden_states(params, cfg: LlamaConfig, tokens, lengths=None):
+    """Full-sequence causal forward → final-norm hidden states [B, S, H].
+    `lengths` masks padded positions out of attention (defaults to full)."""
     b, s = tokens.shape
     cos, sin = rope_table(cfg.rope, s)
     positions = jnp.arange(s)[None, :].repeat(b, 0)
-    lengths = jnp.full((b,), s, jnp.int32)
+    if lengths is None:
+        lengths = jnp.full((b,), s, jnp.int32)
     x = params["embed"].astype(cfg.jdtype)[tokens]
+    x = _shard_act(x, P("data", None, None))
 
     def layer(x, lp):
         h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
         q, k, v = _qkv(h, lp, cfg)
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
+        q = _shard_act(q, P("data", None, "model", None))
         attn = mha_prefill(q, k, v, lengths, sliding_window=cfg.sliding_window)
         x = x + attn.reshape(b, s, -1) @ lp["wo"]
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         x = x + _mlp(h, lp)
+        x = _shard_act(x, P("data", None, None))
         return x, None
 
     x, _ = jax.lax.scan(layer, x, params["layers"])
-    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    return rms_norm(x, params["final_norm"], cfg.rms_eps)
+
+
+def forward_train(params, cfg: LlamaConfig, tokens):
+    """Full-sequence causal forward → logits [B, S, V] (training / eval path)."""
+    x = hidden_states(params, cfg, tokens)
     head = params.get("lm_head", None)
     if head is None:
         head = params["embed"].T
     return x.astype(jnp.float32) @ head.astype(jnp.float32)
+
+
+def encode_pooled(params, cfg: LlamaConfig, tokens, lengths, normalize=True):
+    """Masked-mean-pooled embeddings [B, H] f32 — the embeddings path
+    (reference: mean_pooling + Embedding RPC,
+    /root/reference/backend/python/transformers/backend.py:37,323)."""
+    b, s = tokens.shape
+    x = hidden_states(params, cfg, tokens, lengths).astype(jnp.float32)
+    mask = (jnp.arange(s)[None, :] < lengths[:, None]).astype(jnp.float32)
+    pooled = (x * mask[..., None]).sum(1) / jnp.maximum(
+        mask.sum(1)[:, None], 1.0
+    )
+    if normalize:
+        pooled = pooled / jnp.maximum(
+            jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9
+        )
+    return pooled
