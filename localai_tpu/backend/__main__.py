@@ -6,6 +6,15 @@ from localai_tpu.backend.server import ROLES, serve_blocking
 
 
 def main(argv=None):
+    import os
+
+    # must run before any jax device use (the hermetic-CPU test knob; the
+    # axon site hook otherwise owns backend selection)
+    plat = os.environ.get("LOCALAI_JAX_PLATFORM")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
     p = argparse.ArgumentParser(prog="localai_tpu.backend")
     p.add_argument("--addr", default="127.0.0.1:50051")
     p.add_argument("--backend", default="llm", choices=sorted(ROLES))

@@ -1,0 +1,6 @@
+from localai_tpu.config.app_config import AppConfig  # noqa: F401
+from localai_tpu.config.model_config import (  # noqa: F401
+    ModelConfig,
+    ModelConfigLoader,
+    PredictionParams,
+)

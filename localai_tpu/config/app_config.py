@@ -1,0 +1,45 @@
+"""Application-wide configuration (reference ApplicationConfig,
+/root/reference/core/config/application_config.go:14 + CLI flag surface
+core/cli/run.go:24-77). Layering: CLI flags > env (LOCALAI_*) > defaults."""
+from __future__ import annotations
+
+import dataclasses
+import os
+
+
+@dataclasses.dataclass
+class AppConfig:
+    address: str = "127.0.0.1:8080"
+    models_path: str = "models"
+    backends_path: str = ""          # spawn cwd for backend procs ("" = cwd)
+    context_size: int = 0
+    parallel_requests: int = 4       # default engine slots per model
+    api_keys: list[str] = dataclasses.field(default_factory=list)
+    cors: bool = False
+    single_active_backend: bool = False
+    watchdog_idle_timeout: float = 0.0   # seconds; 0 = disabled
+    watchdog_busy_timeout: float = 0.0
+    preload_models: list[str] = dataclasses.field(default_factory=list)
+    log_level: str = "info"
+    machine_tag: str = ""
+
+    @classmethod
+    def from_env(cls, **overrides) -> "AppConfig":
+        def env(name, cast=str, default=None):
+            v = os.environ.get(f"LOCALAI_{name}")
+            return cast(v) if v is not None else default
+
+        cfg = cls()
+        for field, cast in [("address", str), ("models_path", str),
+                            ("context_size", int), ("parallel_requests", int),
+                            ("machine_tag", str)]:
+            v = env(field.upper(), cast)
+            if v is not None:
+                setattr(cfg, field, v)
+        keys = env("API_KEY", str)
+        if keys:
+            cfg.api_keys = [k.strip() for k in keys.split(",") if k.strip()]
+        for k, v in overrides.items():
+            if v is not None and hasattr(cfg, k):
+                setattr(cfg, k, v)
+        return cfg

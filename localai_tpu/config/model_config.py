@@ -1,0 +1,178 @@
+"""Per-model YAML configuration — the ModelConfig schema.
+
+Mirrors the reference's YAML surface (field names included) so existing model
+YAMLs translate directly: /root/reference/core/config/model_config.go:30-83
+(ModelConfig), :178-240 (LLMConfig knobs), with prediction defaults nested
+under `parameters:` exactly like the reference. Multi-model single files
+(YAML list) are supported (model_config_loader.go:163).
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from typing import Any
+
+import yaml
+
+
+@dataclasses.dataclass
+class PredictionParams:
+    """Request-level defaults a model YAML can pin (reference
+    `parameters:` block + OpenAIRequest merge, schema/prediction.go:4-29)."""
+    model: str = ""                  # checkpoint dir (relative to models path)
+    temperature: float | None = None
+    top_k: int | None = None
+    top_p: float | None = None
+    min_p: float | None = None
+    typical_p: float | None = None
+    repeat_penalty: float | None = None
+    presence_penalty: float | None = None
+    frequency_penalty: float | None = None
+    seed: int | None = None
+    max_tokens: int | None = None
+    ignore_eos: bool | None = None
+    logit_bias: dict[int, float] | None = None
+    language: str | None = None      # transcription default
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "PredictionParams":
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+
+@dataclasses.dataclass
+class TemplateConfig:
+    """Prompt template names/inline bodies (reference TemplateConfig,
+    model_config.go:249-283). `use_tokenizer_template` routes chat through
+    the HF tokenizer's chat template instead."""
+    chat: str = ""
+    chat_message: str = ""
+    completion: str = ""
+    edit: str = ""
+    use_tokenizer_template: bool = True
+
+
+@dataclasses.dataclass
+class MeshShape:
+    data: int = 0    # 0 = auto
+    model: int = 0
+
+
+@dataclasses.dataclass
+class ModelConfig:
+    name: str = ""
+    backend: str = "llm"             # backend role (llm|whisper|store|...)
+    description: str = ""
+    usage: str = ""
+    parameters: PredictionParams = dataclasses.field(default_factory=PredictionParams)
+    template: TemplateConfig = dataclasses.field(default_factory=TemplateConfig)
+    context_size: int = 0            # 0 = model default (capped 2048)
+    parallel: int = 0                # engine slots; 0 = app default
+    embeddings: bool = False
+    rerank: bool = False
+    dtype: str = ""                  # bfloat16|float32 (engine compute dtype)
+    stopwords: list[str] = dataclasses.field(default_factory=list)
+    prefill_buckets: list[int] = dataclasses.field(default_factory=list)
+    mesh: MeshShape = dataclasses.field(default_factory=MeshShape)
+    grammar: str = ""
+    known_usecases: list[str] = dataclasses.field(default_factory=list)
+    # file this config came from (set by the loader)
+    config_file: str = ""
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "ModelConfig":
+        d = dict(d)
+        params = d.pop("parameters", {}) or {}
+        tmpl = d.pop("template", {}) or {}
+        mesh = d.pop("mesh", {}) or {}
+        known = {f.name for f in dataclasses.fields(cls)}
+        cfg = cls(**{k: v for k, v in d.items() if k in known})
+        cfg.parameters = PredictionParams.from_dict(params)
+        cfg.template = TemplateConfig(**{
+            k: v for k, v in tmpl.items()
+            if k in {f.name for f in dataclasses.fields(TemplateConfig)}
+        })
+        cfg.mesh = MeshShape(**{k: v for k, v in mesh.items()
+                                if k in ("data", "model")})
+        return cfg
+
+    def model_dir(self, models_path: str) -> str:
+        m = self.parameters.model or self.name
+        return m if os.path.isabs(m) else os.path.join(models_path, m)
+
+    def validate(self) -> list[str]:
+        errs = []
+        if not self.name:
+            errs.append("missing name")
+        if self.context_size < 0:
+            errs.append("context_size < 0")
+        if any(b <= 0 for b in self.prefill_buckets):
+            errs.append("non-positive prefill bucket")
+        return errs
+
+
+class ModelConfigLoader:
+    """Scans a models directory for YAML configs; hot-rescans on demand
+    (reference model_config_loader.go:118-373 + per-request rescan
+    middleware/request.go:87-117). Bare checkpoint dirs (config.json present)
+    are auto-registered so `models_path/<name>` works without YAML."""
+
+    def __init__(self, models_path: str):
+        self.models_path = models_path
+        self._configs: dict[str, ModelConfig] = {}
+        self._lock = threading.Lock()
+        self.reload()
+
+    def reload(self):
+        configs: dict[str, ModelConfig] = {}
+        if os.path.isdir(self.models_path):
+            for fname in sorted(os.listdir(self.models_path)):
+                path = os.path.join(self.models_path, fname)
+                if fname.endswith((".yaml", ".yml")) and os.path.isfile(path):
+                    for cfg in self._load_file(path):
+                        configs[cfg.name] = cfg
+                elif os.path.isdir(path) and os.path.exists(
+                        os.path.join(path, "config.json")):
+                    if fname not in configs:
+                        c = ModelConfig(name=fname)
+                        c.parameters.model = fname
+                        configs.setdefault(fname, c)
+        with self._lock:
+            self._configs = configs
+
+    @staticmethod
+    def _load_file(path: str) -> list[ModelConfig]:
+        with open(path) as f:
+            doc = yaml.safe_load(f)
+        docs = doc if isinstance(doc, list) else [doc]
+        out = []
+        for d in docs:
+            if not isinstance(d, dict):
+                continue
+            cfg = ModelConfig.from_dict(d)
+            cfg.config_file = path
+            if not cfg.validate():
+                out.append(cfg)
+        return out
+
+    def get(self, name: str) -> ModelConfig | None:
+        with self._lock:
+            cfg = self._configs.get(name)
+        if cfg is None:
+            self.reload()  # hot-pickup of newly dropped YAMLs/dirs
+            with self._lock:
+                cfg = self._configs.get(name)
+        return cfg
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._configs)
+
+    def all(self) -> list[ModelConfig]:
+        with self._lock:
+            return [self._configs[k] for k in sorted(self._configs)]
+
+    def first(self) -> ModelConfig | None:
+        names = self.names()
+        return self.get(names[0]) if names else None

@@ -1,0 +1,1 @@
+from localai_tpu.core.manager import ModelManager, BackendHandle  # noqa: F401

@@ -1,0 +1,196 @@
+"""Model lifecycle: spawn backend processes, health-poll, load, reap, watchdog.
+
+The reference's L3 (/root/reference/pkg/model): mutex-guarded model map
+(loader.go:22-41), spawn on a free localhost port + health poll + LoadModel
+RPC (process.go:93-160, initializers.go:50-154), dead-process reap on cache
+hit (loader.go:191-225), busy/idle watchdog (watchdog.go:19-49), single-active
+-backend serialization (initializers.go:205-226).
+"""
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+
+from localai_tpu.backend.client import BackendClient
+from localai_tpu.config import AppConfig, ModelConfig
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@dataclass
+class BackendHandle:
+    name: str
+    config: ModelConfig
+    proc: subprocess.Popen
+    client: BackendClient
+    port: int
+    busy: int = 0                 # in-flight requests
+    last_used: float = field(default_factory=time.monotonic)
+    busy_since: float = 0.0
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def mark_busy(self):
+        with self._lock:
+            if self.busy == 0:
+                self.busy_since = time.monotonic()
+            self.busy += 1
+
+    def mark_idle(self):
+        with self._lock:
+            self.busy = max(0, self.busy - 1)
+            self.last_used = time.monotonic()
+
+
+class ModelManager:
+    """name → running backend process; the control plane's only way to reach
+    model compute."""
+
+    def __init__(self, app: AppConfig):
+        self.app = app
+        self._models: dict[str, BackendHandle] = {}
+        self._lock = threading.Lock()
+        self._watchdog: threading.Thread | None = None
+        self._stop = threading.Event()
+
+    # ------------------------------------------------------------ spawn/load
+
+    def _spawn(self, cfg: ModelConfig) -> BackendHandle:
+        port = free_port()
+        env = dict(os.environ)
+        env.setdefault("PYTHONPATH", os.getcwd())
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "localai_tpu.backend",
+             "--addr", f"127.0.0.1:{port}", "--backend", cfg.backend],
+            env=env,
+            cwd=self.app.backends_path or None,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        # tail child output into our log (reference process.go:140-157)
+        threading.Thread(target=self._tail, args=(cfg.name, proc),
+                         daemon=True).start()
+        client = BackendClient(f"127.0.0.1:{port}")
+        if not client.wait_ready(attempts=240, sleep=0.5):
+            proc.terminate()
+            raise RuntimeError(f"backend for {cfg.name} never became healthy")
+        return BackendHandle(name=cfg.name, config=cfg, proc=proc,
+                             client=client, port=port)
+
+    @staticmethod
+    def _tail(name: str, proc: subprocess.Popen):
+        for line in proc.stdout or []:
+            print(f"[backend:{name}] {line.rstrip()}", flush=True)
+
+    def _load_rpc(self, handle: BackendHandle):
+        cfg = self.app
+        m = handle.config
+        r = handle.client.load_model(
+            model=m.model_dir(cfg.models_path),
+            context_size=m.context_size or cfg.context_size,
+            parallel=m.parallel or cfg.parallel_requests,
+            dtype=m.dtype,
+            prefill_buckets=m.prefill_buckets,
+            mesh_data=m.mesh.data,
+            mesh_model=m.mesh.model,
+            embeddings=m.embeddings or m.backend == "embedding",
+        )
+        if not r.success:
+            raise RuntimeError(f"LoadModel({m.name}) failed: {r.message}")
+
+    # ------------------------------------------------------------ public api
+
+    def load(self, cfg: ModelConfig) -> BackendHandle:
+        """Get-or-start the backend for a model config. Health-rechecks cached
+        processes and reaps+respawns dead ones (loader.go:191-225)."""
+        with self._lock:
+            h = self._models.get(cfg.name)
+            if h is not None:
+                if h.alive() and h.client.health(timeout=5.0):
+                    h.last_used = time.monotonic()
+                    return h
+                self._reap_locked(h)
+            if self.app.single_active_backend:
+                for other in list(self._models.values()):
+                    self._reap_locked(other)
+            h = self._spawn(cfg)
+            try:
+                self._load_rpc(h)
+            except Exception:
+                self._reap_locked(h)
+                raise
+            self._models[cfg.name] = h
+            return h
+
+    def get(self, name: str) -> BackendHandle | None:
+        with self._lock:
+            return self._models.get(name)
+
+    def loaded(self) -> list[str]:
+        with self._lock:
+            return sorted(self._models)
+
+    def _reap_locked(self, h: BackendHandle):
+        self._models.pop(h.name, None)
+        h.client.close()
+        if h.alive():
+            h.proc.terminate()
+            try:
+                h.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                h.proc.kill()  # forced-shutdown escape hatch (process.go:29-43)
+
+    def stop_model(self, name: str) -> bool:
+        with self._lock:
+            h = self._models.get(name)
+            if h is None:
+                return False
+            self._reap_locked(h)
+            return True
+
+    def stop_all(self):
+        self._stop.set()
+        with self._lock:
+            for h in list(self._models.values()):
+                self._reap_locked(h)
+
+    # ------------------------------------------------------------ watchdog
+
+    def start_watchdog(self, interval: float = 5.0):
+        """Kill backends busy or idle past thresholds (watchdog.go:19-49)."""
+        if self._watchdog or not (self.app.watchdog_idle_timeout
+                                  or self.app.watchdog_busy_timeout):
+            return
+        self._watchdog = threading.Thread(
+            target=self._watchdog_loop, args=(interval,), daemon=True)
+        self._watchdog.start()
+
+    def _watchdog_loop(self, interval: float):
+        idle_t = self.app.watchdog_idle_timeout
+        busy_t = self.app.watchdog_busy_timeout
+        while not self._stop.wait(interval):
+            now = time.monotonic()
+            with self._lock:
+                for h in list(self._models.values()):
+                    if (busy_t and h.busy > 0
+                            and now - h.busy_since > busy_t):
+                        print(f"[watchdog] {h.name} busy > {busy_t}s — reaping",
+                              flush=True)
+                        self._reap_locked(h)
+                    elif (idle_t and h.busy == 0
+                            and now - h.last_used > idle_t):
+                        print(f"[watchdog] {h.name} idle > {idle_t}s — reaping",
+                              flush=True)
+                        self._reap_locked(h)

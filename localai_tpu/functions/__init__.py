@@ -1,0 +1,12 @@
+"""Function calling + constrained decoding (reference: /root/reference/pkg/
+functions — tools → BNF grammar via grammars/json_schema.go:1-258, result
+parsing in parse.go)."""
+from localai_tpu.functions.grammars import (  # noqa: F401
+    json_schema_grammar,
+    JSON_GRAMMAR,
+)
+from localai_tpu.functions.tools import (  # noqa: F401
+    grammar_for_request,
+    parse_tool_calls,
+    tools_schema,
+)

@@ -1,0 +1,162 @@
+"""JSON-schema → GBNF grammar generation.
+
+Role of /root/reference/pkg/functions/grammars/json_schema.go:1-258 (schema
+converter) + json_mode.go (generic-JSON grammar), re-written for this
+framework: the output GBNF is consumed by our own matcher
+(localai_tpu/functions/matcher.py + native lib) to build per-step token masks
+on the host, the TPU answer to llama.cpp's in-sampler grammar enforcement.
+
+GBNF subset emitted: `rule ::= production`, literals "...", char classes
+[a-z0-9], ( ) grouping, | alternation, * + ? repetition.
+"""
+from __future__ import annotations
+
+import json
+import re
+from typing import Any
+
+_SPACE = 'space ::= " "?'
+
+# primitive rules shared by every grammar
+_PRIMITIVES = {
+    "boolean": 'boolean ::= ("true" | "false") space',
+    "null": 'null ::= "null" space',
+    "string": r'''string ::= "\"" (
+  [^"\\] |
+  "\\" (["\\/bfnrt] | "u" [0-9a-fA-F] [0-9a-fA-F] [0-9a-fA-F] [0-9a-fA-F])
+)* "\"" space''',
+    "number": 'number ::= ("-"? ([0-9] | [1-9] [0-9]*)) ("." [0-9]+)? '
+              '([eE] [-+]? [0-9]+)? space',
+    "integer": 'integer ::= ("-"? ([0-9] | [1-9] [0-9]*)) space',
+    "value": 'value ::= object | array | string | number | boolean | null',
+    "object": 'object ::= "{" space (string ":" space value ("," space string '
+              '":" space value)*)? "}" space',
+    "array": 'array ::= "[" space (value ("," space value)*)? "]" space',
+}
+
+# grammar accepting any JSON object — the `json_object` response_format
+# (reference json_mode.go JSONBNF)
+JSON_GRAMMAR = "\n".join(
+    ["root ::= object", _SPACE] + [
+        _PRIMITIVES[k]
+        for k in ("object", "array", "string", "number", "boolean", "null",
+                  "value")
+    ]
+)
+
+
+def _literal(s: str) -> str:
+    return json.dumps(s)
+
+
+def _name_ok(s: str) -> str:
+    return re.sub(r"[^a-zA-Z0-9-]", "-", s) or "r"
+
+
+class _Converter:
+    def __init__(self):
+        self.rules: dict[str, str] = {"space": _SPACE.split("::= ", 1)[1]}
+        self._used_prims: set[str] = set()
+        self.defs: dict[str, Any] = {}
+
+    def _add(self, name: str, production: str) -> str:
+        base = _name_ok(name)
+        key = base
+        i = 0
+        while key in self.rules and self.rules[key] != production:
+            i += 1
+            key = f"{base}{i}"
+        self.rules[key] = production
+        return key
+
+    def _prim(self, name: str) -> str:
+        if name not in self.rules:
+            self.rules[name] = _PRIMITIVES[name].split("::= ", 1)[1]
+            if name in ("value", "object", "array"):
+                # the freeform trio is mutually recursive
+                for dep in ("object", "array", "string", "number", "boolean",
+                            "null", "value"):
+                    if dep not in self.rules:
+                        self.rules[dep] = _PRIMITIVES[dep].split("::= ", 1)[1]
+        return name
+
+    def visit(self, schema: Any, name: str) -> str:
+        if schema is True or schema in ({}, None):
+            return self._prim("value")
+        if "$defs" in schema:
+            self.defs.update(schema["$defs"])
+        if "$ref" in schema:
+            ref = schema["$ref"].split("/")[-1]
+            if ref in self.defs:
+                return self.visit(self.defs[ref], ref)
+            return self._prim("value")
+        if "const" in schema:
+            return self._add(name, f"{_literal(json.dumps(schema['const']))} space")
+        if "enum" in schema:
+            alts = " | ".join(_literal(json.dumps(v)) for v in schema["enum"])
+            return self._add(name, f"({alts}) space")
+        for comb in ("oneOf", "anyOf"):
+            if comb in schema:
+                subs = [self.visit(s, f"{name}-{i}")
+                        for i, s in enumerate(schema[comb])]
+                return self._add(name, "(" + " | ".join(subs) + ")")
+
+        t = schema.get("type")
+        if isinstance(t, list):
+            subs = [self.visit({**schema, "type": ti}, f"{name}-{ti}")
+                    for ti in t]
+            return self._add(name, "(" + " | ".join(subs) + ")")
+        if t == "object" or (t is None and "properties" in schema):
+            return self._object(schema, name)
+        if t == "array":
+            item = self.visit(schema.get("items", True), f"{name}-item")
+            prod = f'"[" space ({item} ("," space {item})*)? "]" space'
+            return self._add(name, prod)
+        if t in ("string",):
+            return self._prim("string")
+        if t in ("number",):
+            return self._prim("number")
+        if t in ("integer",):
+            return self._prim("integer")
+        if t in ("boolean",):
+            return self._prim("boolean")
+        if t in ("null",):
+            return self._prim("null")
+        return self._prim("value")
+
+    def _object(self, schema: dict, name: str) -> str:
+        props = schema.get("properties", {})
+        required = set(schema.get("required", list(props)))
+        if not props:
+            return self._prim("object")
+        parts = []
+        first = True
+        # fixed property order (sorted required-first) keeps the grammar
+        # regular — same simplification the reference makes
+        ordered = [k for k in props if k in required] + [
+            k for k in props if k not in required
+        ]
+        for k in ordered:
+            sub = self.visit(props[k], f"{name}-{k}")
+            kv = f'{_literal(json.dumps(k))} space ":" space {sub}'
+            if k in required:
+                sep = "" if first else '"," space '
+                parts.append(f"{sep}{kv}")
+                first = False
+            else:
+                sep = '"," space ' if not first else ""
+                parts.append(f"({sep}{kv})?")
+        prod = '"{" space ' + " ".join(parts) + ' "}" space'
+        return self._add(name, prod)
+
+
+def json_schema_grammar(schema: dict | str) -> str:
+    """Compile a JSON schema into a GBNF grammar with root rule `root`."""
+    if isinstance(schema, str):
+        schema = json.loads(schema)
+    c = _Converter()
+    root = c.visit(schema, "root-v")
+    lines = [f"root ::= {root} space" if root != "root" else ""]
+    for k, v in c.rules.items():
+        lines.append(f"{k} ::= {v}")
+    return "\n".join(l for l in lines if l)

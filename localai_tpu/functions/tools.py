@@ -1,0 +1,80 @@
+"""OpenAI tools / response_format → grammar, and output → tool_calls parsing
+(reference: /root/reference/pkg/functions/functions.go ToJSONStructure +
+parse.go result parsing; wiring in core/http/endpoints/openai/chat.go:224-312).
+"""
+from __future__ import annotations
+
+import json
+from typing import Any
+
+from localai_tpu.functions.grammars import JSON_GRAMMAR, json_schema_grammar
+
+
+def tools_schema(tools: list[dict]) -> dict:
+    """Schema matching {"name": <one of the tools>, "arguments": {...}} —
+    the reference's ToJSONStructure shape (functions.go)."""
+    alts = []
+    for t in tools:
+        fn = t.get("function", t)
+        alts.append({
+            "type": "object",
+            "properties": {
+                "name": {"const": fn.get("name", "")},
+                "arguments": fn.get("parameters", {"type": "object"}),
+            },
+            "required": ["name", "arguments"],
+        })
+    if len(alts) == 1:
+        return alts[0]
+    return {"oneOf": alts}
+
+
+def grammar_for_request(body: dict) -> str:
+    """response_format / tools → GBNF (chat.go:224-312 semantics):
+    json_object → generic JSON; json_schema → compiled schema; tools (unless
+    tool_choice=none) → tool-call schema."""
+    rf = body.get("response_format") or {}
+    if isinstance(rf, str):
+        rf = {"type": rf}
+    if rf.get("type") == "json_object":
+        return JSON_GRAMMAR
+    if rf.get("type") == "json_schema":
+        schema = (rf.get("json_schema") or {}).get("schema") or {}
+        return json_schema_grammar(schema)
+    tools = body.get("tools") or []
+    if tools and body.get("tool_choice") != "none":
+        choice = body.get("tool_choice")
+        if isinstance(choice, dict):
+            want = choice.get("function", {}).get("name")
+            tools = [t for t in tools
+                     if t.get("function", t).get("name") == want] or tools
+        return json_schema_grammar(tools_schema(tools))
+    return ""
+
+
+def parse_tool_calls(text: str) -> list[dict[str, Any]] | None:
+    """Parse model output into OpenAI tool_calls (parse.go role). Returns
+    None when the output isn't a tool-call JSON object."""
+    text = text.strip()
+    if not text.startswith(("{", "[")):
+        return None
+    try:
+        obj = json.loads(text)
+    except json.JSONDecodeError:
+        return None
+    objs = obj if isinstance(obj, list) else [obj]
+    calls = []
+    for i, o in enumerate(objs):
+        if not isinstance(o, dict) or "name" not in o:
+            return None
+        args = o.get("arguments", o.get("parameters", {}))
+        calls.append({
+            "id": f"call_{i}",
+            "type": "function",
+            "function": {
+                "name": o["name"],
+                "arguments": json.dumps(args) if not isinstance(args, str)
+                else args,
+            },
+        })
+    return calls or None

@@ -1,0 +1,1 @@
+from localai_tpu.server.http import API, run_server  # noqa: F401

@@ -1,0 +1,416 @@
+"""The OpenAI-compatible HTTP server (L5) — aiohttp.
+
+Surface mirrors the reference routes (/root/reference/core/http/routes/
+openai.go:13-181 + localai.go): /v1/chat/completions (SSE streaming loop like
+chat.go:334-449), /v1/completions, /v1/embeddings, /v1/models, rerank,
+tokenize, Prometheus /metrics, health. The RequestExtractor middleware
+semantics (request.go:118-211) live in `_merged_options`: per-request JSON
+fields override the model YAML's `parameters:` defaults.
+
+gRPC backends are synchronous; unary calls run in the default executor and
+streams are bridged thread→asyncio.Queue so one slow model never blocks the
+event loop.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+
+from aiohttp import web
+
+from localai_tpu.config import AppConfig, ModelConfig, ModelConfigLoader
+from localai_tpu.core.manager import ModelManager
+from localai_tpu.server import schema
+
+try:
+    from prometheus_client import (
+        CONTENT_TYPE_LATEST, Counter, Histogram, generate_latest,
+    )
+
+    _API_CALLS = Counter("localai_api_calls_total", "API calls",
+                         ["path", "status"])
+    _API_LATENCY = Histogram("localai_api_latency_seconds", "API latency",
+                             ["path"])
+    _HAVE_PROM = True
+except Exception:  # pragma: no cover - prometheus_client is in the image
+    _HAVE_PROM = False
+
+_OPEN_PATHS = {"/healthz", "/readyz", "/metrics"}
+
+# sampling fields copied request-JSON → PredictOptions when present
+_SAMPLING_FIELDS = (
+    "temperature", "top_k", "top_p", "min_p", "typical_p", "repeat_penalty",
+    "presence_penalty", "frequency_penalty", "seed", "ignore_eos",
+)
+
+
+class API:
+    def __init__(self, app_config: AppConfig, configs: ModelConfigLoader,
+                 manager: ModelManager):
+        self.cfg = app_config
+        self.configs = configs
+        self.manager = manager
+        self.app = web.Application(middlewares=[self._middleware])
+        r = self.app.router
+        r.add_get("/healthz", self._health)
+        r.add_get("/readyz", self._health)
+        r.add_get("/metrics", self._metrics)
+        r.add_get("/v1/models", self._models)
+        r.add_get("/models", self._models)
+        r.add_post("/v1/chat/completions", self._chat)
+        r.add_post("/chat/completions", self._chat)
+        r.add_post("/v1/completions", self._completions)
+        r.add_post("/completions", self._completions)
+        r.add_post("/v1/embeddings", self._embeddings)
+        r.add_post("/embeddings", self._embeddings)
+        r.add_post("/v1/rerank", self._rerank)
+        r.add_post("/rerank", self._rerank)
+        r.add_post("/v1/tokenize", self._tokenize)
+        r.add_post("/tokenize", self._tokenize)
+        r.add_get("/backend/monitor", self._backend_monitor)
+        r.add_post("/backend/shutdown", self._backend_shutdown)
+
+    # ------------------------------------------------------------ middleware
+
+    @web.middleware
+    async def _middleware(self, request: web.Request, handler):
+        t0 = time.perf_counter()
+        status = 500
+        try:
+            if self.cfg.api_keys and request.path not in _OPEN_PATHS:
+                auth = request.headers.get("Authorization", "")
+                key = auth.removeprefix("Bearer ").strip()
+                if key not in self.cfg.api_keys:
+                    status = 401
+                    return web.json_response(
+                        schema.error_body("invalid api key",
+                                          "authentication_error", 401),
+                        status=401)
+            resp = await handler(request)
+            status = resp.status
+            return resp
+        except web.HTTPException as e:
+            status = e.status
+            raise
+        except Exception as e:
+            status = 500
+            return web.json_response(
+                schema.error_body(f"{type(e).__name__}: {e}", "server_error",
+                                  500), status=500)
+        finally:
+            if _HAVE_PROM:
+                _API_CALLS.labels(request.path, str(status)).inc()
+                _API_LATENCY.labels(request.path).observe(
+                    time.perf_counter() - t0)
+
+    # ------------------------------------------------------------ helpers
+
+    def _resolve(self, body: dict) -> ModelConfig:
+        """Model-name defaulting + config resolve (request.go:87-117)."""
+        name = body.get("model") or ""
+        cfg = self.configs.get(name) if name else self.configs.first()
+        if cfg is None:
+            raise web.HTTPNotFound(
+                text=json.dumps(schema.error_body(
+                    f"model {name!r} not found", code=404)),
+                content_type="application/json")
+        return cfg
+
+    async def _handle(self, cfg: ModelConfig):
+        try:
+            return await asyncio.to_thread(self.manager.load, cfg)
+        except Exception as e:
+            raise web.HTTPInternalServerError(
+                text=json.dumps(schema.error_body(
+                    f"backend load failed: {e}", "server_error", 500)),
+                content_type="application/json")
+
+    def _merged_options(self, cfg: ModelConfig, body: dict) -> dict:
+        """request JSON > model YAML defaults (request.go:118-211)."""
+        p = cfg.parameters
+        opts: dict = {}
+        for f in _SAMPLING_FIELDS:
+            v = body.get(f, getattr(p, f, None))
+            if v is not None:
+                opts[f] = v
+        max_tokens = body.get("max_tokens", body.get("max_completion_tokens",
+                                                     p.max_tokens))
+        if max_tokens:
+            opts["tokens"] = int(max_tokens)
+        stop = body.get("stop", None)
+        if stop is None:
+            stop = list(cfg.stopwords)
+        elif isinstance(stop, str):
+            stop = [stop]
+        if stop:
+            opts["stop_prompts"] = stop
+        bias = body.get("logit_bias", p.logit_bias)
+        if bias:
+            opts["logit_bias"] = {int(k): float(v) for k, v in bias.items()}
+        if cfg.grammar:
+            opts["grammar"] = cfg.grammar
+        if body.get("response_format") or body.get("tools"):
+            # grammar-constrained decoding wiring (functions/grammars)
+            from localai_tpu.functions import grammar_for_request
+
+            g = grammar_for_request(body)
+            if g:
+                opts["grammar"] = g
+        if body.get("logprobs"):
+            opts["logprobs"] = True
+        return opts
+
+    async def _stream_rpc(self, handle, opts: dict):
+        """Bridge the blocking gRPC stream into an async queue."""
+        loop = asyncio.get_running_loop()
+        q: asyncio.Queue = asyncio.Queue(maxsize=256)
+
+        def pump():
+            try:
+                for reply in handle.client.predict_stream(**opts):
+                    loop.call_soon_threadsafe(q.put_nowait, ("chunk", reply))
+                loop.call_soon_threadsafe(q.put_nowait, ("done", None))
+            except Exception as e:
+                loop.call_soon_threadsafe(q.put_nowait, ("error", e))
+
+        loop.run_in_executor(None, pump)
+        while True:
+            kind, item = await q.get()
+            if kind == "chunk":
+                yield item
+            elif kind == "done":
+                return
+            else:
+                raise item
+
+    # ------------------------------------------------------------ endpoints
+
+    async def _health(self, request):
+        return web.json_response({"status": "ok"})
+
+    async def _metrics(self, request):
+        if not _HAVE_PROM:
+            raise web.HTTPNotImplemented()
+        return web.Response(body=generate_latest(),
+                            content_type=CONTENT_TYPE_LATEST.split(";")[0])
+
+    async def _models(self, request):
+        return web.json_response(schema.models_list(self.configs.names()))
+
+    async def _chat(self, request):
+        body = await request.json()
+        cfg = self._resolve(body)
+        messages = body.get("messages") or []
+        if not messages:
+            raise web.HTTPBadRequest(
+                text=json.dumps(schema.error_body("messages required")),
+                content_type="application/json")
+        handle = await self._handle(cfg)
+        opts = self._merged_options(cfg, body)
+        if cfg.template.use_tokenizer_template or not cfg.template.chat:
+            opts["messages_json"] = json.dumps(messages)
+            opts["use_tokenizer_template"] = True
+        else:
+            from localai_tpu.templates import evaluate_chat
+
+            opts["prompt"] = evaluate_chat(cfg, messages)
+
+        handle.mark_busy()
+        try:
+            if body.get("stream"):
+                return await self._chat_stream(request, cfg, handle, opts)
+            reply = await asyncio.to_thread(
+                lambda: handle.client.predict(**opts))
+            resp = schema.chat_completion(
+                cfg.name, reply.message.decode("utf-8", "replace"),
+                reply.finish_reason, reply.prompt_tokens, reply.tokens,
+                timings={
+                    "prompt_processing_s": reply.timing_prompt_processing,
+                    "token_generation_s": reply.timing_token_generation,
+                })
+            return web.json_response(resp)
+        finally:
+            handle.mark_idle()
+
+    async def _chat_stream(self, request, cfg, handle, opts):
+        """SSE loop (reference chat.go:334-449): role chunk, deltas, usage
+        chunk, data: [DONE]."""
+        resp = web.StreamResponse(headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+            "Connection": "keep-alive",
+        })
+        await resp.prepare(request)
+        rid = schema._id("chatcmpl")
+
+        async def send(obj):
+            await resp.write(f"data: {json.dumps(obj)}\n\n".encode())
+
+        await send(schema.chat_chunk(rid, cfg.name, None, role=True))
+        prompt_tokens = completion_tokens = 0
+        finish = "stop"
+        async for reply in self._stream_rpc(handle, opts):
+            prompt_tokens = reply.prompt_tokens
+            completion_tokens = reply.tokens
+            text = reply.message.decode("utf-8", "replace")
+            if text:
+                await send(schema.chat_chunk(rid, cfg.name, text))
+            if reply.finish_reason:
+                finish = reply.finish_reason
+        await send(schema.chat_chunk(rid, cfg.name, None, finish_reason=finish))
+        if (request.query.get("include_usage")
+                or True):  # usage chunk is cheap and OpenAI-compatible
+            await send(schema.chat_usage_chunk(rid, cfg.name, prompt_tokens,
+                                               completion_tokens))
+        await resp.write(b"data: [DONE]\n\n")
+        await resp.write_eof()
+        return resp
+
+    async def _completions(self, request):
+        body = await request.json()
+        cfg = self._resolve(body)
+        prompt = body.get("prompt") or ""
+        if isinstance(prompt, list):
+            prompt = prompt[0] if prompt else ""
+        handle = await self._handle(cfg)
+        opts = self._merged_options(cfg, body)
+        if cfg.template.completion:
+            from localai_tpu.templates import evaluate_completion
+
+            prompt = evaluate_completion(cfg, prompt)
+        opts["prompt"] = prompt
+
+        handle.mark_busy()
+        try:
+            if body.get("stream"):
+                return await self._completion_stream(request, cfg, handle, opts)
+            reply = await asyncio.to_thread(
+                lambda: handle.client.predict(**opts))
+            return web.json_response(schema.text_completion(
+                cfg.name, reply.message.decode("utf-8", "replace"),
+                reply.finish_reason, reply.prompt_tokens, reply.tokens))
+        finally:
+            handle.mark_idle()
+
+    async def _completion_stream(self, request, cfg, handle, opts):
+        resp = web.StreamResponse(headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+        })
+        await resp.prepare(request)
+        rid = schema._id("cmpl")
+        finish = "stop"
+        async for reply in self._stream_rpc(handle, opts):
+            text = reply.message.decode("utf-8", "replace")
+            if reply.finish_reason:
+                finish = reply.finish_reason
+            if text:
+                await resp.write(
+                    f"data: {json.dumps(schema.text_completion_chunk(rid, cfg.name, text))}\n\n".encode())
+        await resp.write(
+            f"data: {json.dumps(schema.text_completion_chunk(rid, cfg.name, '', finish))}\n\n".encode())
+        await resp.write(b"data: [DONE]\n\n")
+        await resp.write_eof()
+        return resp
+
+    async def _embeddings(self, request):
+        body = await request.json()
+        cfg = self._resolve(body)
+        inputs = body.get("input") or ""
+        if isinstance(inputs, str):
+            inputs = [inputs]
+        handle = await self._handle(cfg)
+
+        handle.mark_busy()
+        try:
+            vectors, total_tokens = [], 0
+            for text in inputs:
+                t = await asyncio.to_thread(
+                    lambda s=text: handle.client.tokenize(s))
+                total_tokens += t.length
+                r = await asyncio.to_thread(
+                    lambda s=text: handle.client.embedding(prompt=s))
+                vectors.append(list(r.embeddings))
+            return web.json_response(schema.embeddings_response(
+                cfg.name, vectors, total_tokens))
+        finally:
+            handle.mark_idle()
+
+    async def _rerank(self, request):
+        body = await request.json()
+        cfg = self._resolve(body)
+        handle = await self._handle(cfg)
+        handle.mark_busy()
+        try:
+            r = await asyncio.to_thread(lambda: handle.client.rerank(
+                query=body.get("query", ""),
+                documents=body.get("documents", []),
+                top_n=body.get("top_n", 0)))
+            return web.json_response({
+                "model": cfg.name,
+                "results": [{
+                    "index": d.index,
+                    "relevance_score": d.relevance_score,
+                    "document": {"text": d.text},
+                } for d in r.results],
+            })
+        finally:
+            handle.mark_idle()
+
+    async def _tokenize(self, request):
+        body = await request.json()
+        cfg = self._resolve(body)
+        handle = await self._handle(cfg)
+        t = await asyncio.to_thread(
+            lambda: handle.client.tokenize(body.get("content", "")))
+        return web.json_response({"tokens": list(t.tokens)})
+
+    async def _backend_monitor(self, request):
+        out = {}
+        for name in self.manager.loaded():
+            h = self.manager.get(name)
+            if h is None:
+                continue
+            st = await asyncio.to_thread(lambda hh=h: hh.client.status())
+            out[name] = {
+                "state": int(st.state),
+                "memory_total": st.memory.total,
+                "busy": h.busy,
+            }
+        return web.json_response(out)
+
+    async def _backend_shutdown(self, request):
+        body = await request.json()
+        ok = await asyncio.to_thread(
+            self.manager.stop_model, body.get("model", ""))
+        return web.json_response({"success": ok})
+
+
+def run_server(args) -> int:
+    """CLI `run` entrypoint: assemble config + manager + API and serve."""
+    app_cfg = AppConfig.from_env(
+        address=getattr(args, "address", None),
+        models_path=getattr(args, "models_path", None),
+        context_size=getattr(args, "context_size", None),
+        parallel_requests=getattr(args, "parallel_requests", None),
+        single_active_backend=getattr(args, "single_active_backend", None),
+        api_keys=getattr(args, "api_keys", None),
+    )
+    for t in ("watchdog_idle_timeout", "watchdog_busy_timeout"):
+        v = getattr(args, t, None)
+        if v:
+            setattr(app_cfg, t, float(v))
+    configs = ModelConfigLoader(app_cfg.models_path)
+    manager = ModelManager(app_cfg)
+    manager.start_watchdog()
+    api = API(app_cfg, configs, manager)
+
+    host, _, port = app_cfg.address.rpartition(":")
+    try:
+        web.run_app(api.app, host=host or "127.0.0.1", port=int(port),
+                    print=lambda *a: print(f"serving on {app_cfg.address}",
+                                           flush=True))
+    finally:
+        manager.stop_all()
+    return 0

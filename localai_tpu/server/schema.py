@@ -1,0 +1,123 @@
+"""OpenAI-compatible wire schema builders (reference structs:
+/root/reference/core/schema/openai.go:40-133). Plain dicts — the contract is
+JSON shape, not types."""
+from __future__ import annotations
+
+import time
+import uuid
+
+
+def _id(prefix: str) -> str:
+    return f"{prefix}-{uuid.uuid4().hex[:24]}"
+
+
+def usage(prompt_tokens: int, completion_tokens: int) -> dict:
+    return {
+        "prompt_tokens": prompt_tokens,
+        "completion_tokens": completion_tokens,
+        "total_tokens": prompt_tokens + completion_tokens,
+    }
+
+
+def chat_completion(model: str, text: str, finish_reason: str,
+                    prompt_tokens: int, completion_tokens: int,
+                    timings: dict | None = None) -> dict:
+    out = {
+        "id": _id("chatcmpl"),
+        "object": "chat.completion",
+        "created": int(time.time()),
+        "model": model,
+        "choices": [{
+            "index": 0,
+            "message": {"role": "assistant", "content": text},
+            "finish_reason": finish_reason or "stop",
+        }],
+        "usage": usage(prompt_tokens, completion_tokens),
+    }
+    if timings:
+        out["timings"] = timings
+    return out
+
+
+def chat_chunk(rid: str, model: str, delta_text: str | None,
+               finish_reason: str | None = None, role: bool = False) -> dict:
+    delta: dict = {}
+    if role:
+        delta["role"] = "assistant"
+    if delta_text:
+        delta["content"] = delta_text
+    return {
+        "id": rid,
+        "object": "chat.completion.chunk",
+        "created": int(time.time()),
+        "model": model,
+        "choices": [{
+            "index": 0,
+            "delta": delta,
+            "finish_reason": finish_reason,
+        }],
+    }
+
+
+def chat_usage_chunk(rid: str, model: str, prompt_tokens: int,
+                     completion_tokens: int) -> dict:
+    return {
+        "id": rid,
+        "object": "chat.completion.chunk",
+        "created": int(time.time()),
+        "model": model,
+        "choices": [],
+        "usage": usage(prompt_tokens, completion_tokens),
+    }
+
+
+def text_completion(model: str, text: str, finish_reason: str,
+                    prompt_tokens: int, completion_tokens: int) -> dict:
+    return {
+        "id": _id("cmpl"),
+        "object": "text_completion",
+        "created": int(time.time()),
+        "model": model,
+        "choices": [{
+            "index": 0,
+            "text": text,
+            "finish_reason": finish_reason or "stop",
+        }],
+        "usage": usage(prompt_tokens, completion_tokens),
+    }
+
+
+def text_completion_chunk(rid: str, model: str, text: str,
+                          finish_reason: str | None = None) -> dict:
+    return {
+        "id": rid,
+        "object": "text_completion",
+        "created": int(time.time()),
+        "model": model,
+        "choices": [{"index": 0, "text": text,
+                     "finish_reason": finish_reason}],
+    }
+
+
+def embeddings_response(model: str, vectors: list[list[float]],
+                        prompt_tokens: int) -> dict:
+    return {
+        "object": "list",
+        "model": model,
+        "data": [{"object": "embedding", "index": i, "embedding": v}
+                 for i, v in enumerate(vectors)],
+        "usage": usage(prompt_tokens, 0),
+    }
+
+
+def models_list(names: list[str]) -> dict:
+    return {
+        "object": "list",
+        "data": [{"id": n, "object": "model", "owned_by": "localai-tpu"}
+                 for n in names],
+    }
+
+
+def error_body(message: str, kind: str = "invalid_request_error",
+               code: int = 400) -> dict:
+    return {"error": {"message": message, "type": kind, "code": code}}

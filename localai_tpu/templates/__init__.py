@@ -1,0 +1,68 @@
+"""Prompt templating (reference: /root/reference/core/templates/
+evaluator.go:58-230 — Go text/template per-model .tmpl files).
+
+TPU-native equivalent uses jinja2 (already the chat-template language of the
+HF ecosystem): a template is either an inline string in the model YAML or a
+`<name>.tmpl` file next to the model config. Per-message `chat_message`
+template renders each message, results are joined and fed to the `chat`
+template as `{{ input }}` — the reference's two-stage evaluation
+(evaluator.go:96-230).
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+import jinja2
+
+_env = jinja2.Environment(trim_blocks=True, lstrip_blocks=True,
+                          keep_trailing_newline=True)
+
+
+@functools.lru_cache(maxsize=256)
+def _compile(src: str) -> jinja2.Template:
+    return _env.from_string(src)
+
+
+def _resolve_source(cfg, name_or_body: str) -> str:
+    """Inline body if it looks like a template, else `<stem>.tmpl` file next
+    to the model's YAML (evaluator.go template-file lookup)."""
+    if "{{" in name_or_body or "\n" in name_or_body:
+        return name_or_body
+    base = os.path.dirname(cfg.config_file) if cfg.config_file else "."
+    path = os.path.join(base, name_or_body + ".tmpl")
+    if os.path.exists(path):
+        with open(path) as f:
+            return f.read()
+    return name_or_body  # literal passthrough
+
+
+def evaluate_chat(cfg, messages: list[dict]) -> str:
+    """Render messages with chat_message (if set) then the chat template."""
+    rendered = []
+    msg_tmpl = cfg.template.chat_message
+    for i, m in enumerate(messages):
+        content = m.get("content") or ""
+        if isinstance(content, list):  # OpenAI multimodal content parts
+            content = "".join(p.get("text", "") for p in content
+                              if isinstance(p, dict))
+        if msg_tmpl:
+            rendered.append(_compile(_resolve_source(cfg, msg_tmpl)).render(
+                role=m.get("role", "user"), content=content, index=i,
+                message=m))
+        else:
+            rendered.append(f"{m.get('role', 'user')}: {content}")
+    joined = "\n".join(rendered)
+    chat_tmpl = cfg.template.chat
+    if not chat_tmpl:
+        return joined
+    return _compile(_resolve_source(cfg, chat_tmpl)).render(
+        input=joined, messages=messages, model=cfg.name)
+
+
+def evaluate_completion(cfg, prompt: str) -> str:
+    tmpl = cfg.template.completion
+    if not tmpl:
+        return prompt
+    return _compile(_resolve_source(cfg, tmpl)).render(
+        input=prompt, model=cfg.name)

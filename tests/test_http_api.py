@@ -1,0 +1,209 @@
+"""HTTP integration tests — the reference's app_test.go tier (SURVEY §4):
+a REAL server (aiohttp in a thread), REAL backend subprocesses via the
+ModelManager, driven over the wire with `requests`.
+"""
+import asyncio
+import json
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+import requests
+import yaml
+
+from fixtures import tiny_checkpoint
+
+
+def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    """models dir + config loader + manager + API server on a real port."""
+    from aiohttp import web
+
+    from localai_tpu.config import AppConfig, ModelConfigLoader
+    from localai_tpu.core.manager import ModelManager
+    from localai_tpu.server.http import API
+
+    ckpt = tiny_checkpoint(tmp_path_factory)
+    models = tmp_path_factory.mktemp("models")
+    (models / "tiny.yaml").write_text(yaml.safe_dump({
+        "name": "tiny",
+        "backend": "llm",
+        "context_size": 128,
+        "parallel": 2,
+        "dtype": "float32",
+        "embeddings": True,
+        "prefill_buckets": [32, 64],
+        "parameters": {
+            "model": ckpt,
+            "temperature": 0.0,
+            "max_tokens": 8,
+        },
+    }))
+
+    os.environ["LOCALAI_JAX_PLATFORM"] = "cpu"
+    port = _free_port()
+    app_cfg = AppConfig(address=f"127.0.0.1:{port}",
+                        models_path=str(models), parallel_requests=2)
+    configs = ModelConfigLoader(str(models))
+    manager = ModelManager(app_cfg)
+    api = API(app_cfg, configs, manager)
+
+    loop = asyncio.new_event_loop()
+
+    def run():
+        asyncio.set_event_loop(loop)
+        runner = web.AppRunner(api.app)
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, "127.0.0.1", port)
+        loop.run_until_complete(site.start())
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{port}"
+    for _ in range(50):
+        try:
+            requests.get(base + "/healthz", timeout=1)
+            break
+        except requests.ConnectionError:
+            time.sleep(0.1)
+    yield base, manager
+    manager.stop_all()
+    loop.call_soon_threadsafe(loop.stop)
+
+
+def test_models_list(stack):
+    base, _ = stack
+    r = requests.get(base + "/v1/models", timeout=10)
+    assert r.status_code == 200
+    assert [m["id"] for m in r.json()["data"]] == ["tiny"]
+
+
+def test_chat_nonstream(stack):
+    base, _ = stack
+    r = requests.post(base + "/v1/chat/completions", json={
+        "model": "tiny",
+        "messages": [{"role": "user", "content": "hello"}],
+        "max_tokens": 6,
+    }, timeout=300)
+    assert r.status_code == 200, r.text
+    body = r.json()
+    assert body["object"] == "chat.completion"
+    assert body["choices"][0]["message"]["role"] == "assistant"
+    assert body["usage"]["completion_tokens"] == 6
+    assert body["choices"][0]["finish_reason"] in ("length", "stop", "eos")
+
+
+def test_chat_stream_sse(stack):
+    base, _ = stack
+    r = requests.post(base + "/v1/chat/completions", json={
+        "model": "tiny",
+        "messages": [{"role": "user", "content": "the quick"}],
+        "max_tokens": 5,
+        "stream": True,
+    }, stream=True, timeout=300)
+    assert r.status_code == 200
+    assert r.headers["Content-Type"].startswith("text/event-stream")
+    events = []
+    for line in r.iter_lines():
+        if line.startswith(b"data: "):
+            payload = line[6:]
+            if payload == b"[DONE]":
+                events.append("DONE")
+            else:
+                events.append(json.loads(payload))
+    assert events[-1] == "DONE"
+    chunks = [e for e in events if e != "DONE"]
+    assert chunks[0]["choices"][0]["delta"].get("role") == "assistant"
+    assert any(c["choices"] and c["choices"][0]["delta"].get("content")
+               for c in chunks)
+    finals = [c for c in chunks
+              if c["choices"] and c["choices"][0]["finish_reason"]]
+    assert finals, "missing finish_reason chunk"
+    assert chunks[-1].get("usage", {}).get("completion_tokens") == 5
+
+
+def test_completions(stack):
+    base, _ = stack
+    r = requests.post(base + "/v1/completions", json={
+        "model": "tiny", "prompt": "pack my box", "max_tokens": 4,
+    }, timeout=300)
+    assert r.status_code == 200, r.text
+    body = r.json()
+    assert body["object"] == "text_completion"
+    assert body["usage"]["completion_tokens"] == 4
+
+
+def test_embeddings_endpoint(stack):
+    base, _ = stack
+    r = requests.post(base + "/v1/embeddings", json={
+        "model": "tiny",
+        "input": ["the quick brown fox", "the quick brown foxes", "zzz 123"],
+    }, timeout=300)
+    assert r.status_code == 200, r.text
+    data = r.json()["data"]
+    v = [np.array(d["embedding"]) for d in data]
+    assert all(abs(np.linalg.norm(x) - 1.0) < 1e-5 for x in v)
+    assert float(v[0] @ v[1]) > float(v[0] @ v[2])
+
+
+def test_tokenize_endpoint(stack):
+    base, _ = stack
+    r = requests.post(base + "/v1/tokenize", json={
+        "model": "tiny", "content": "hello world"}, timeout=60)
+    assert r.status_code == 200
+    assert len(r.json()["tokens"]) > 0
+
+
+def test_unknown_model_404(stack):
+    base, _ = stack
+    r = requests.post(base + "/v1/chat/completions", json={
+        "model": "nope", "messages": [{"role": "user", "content": "x"}],
+    }, timeout=30)
+    assert r.status_code == 404
+
+
+def test_backend_monitor(stack):
+    base, _ = stack
+    r = requests.get(base + "/backend/monitor", timeout=60)
+    assert r.status_code == 200
+    assert r.json()["tiny"]["state"] == 2  # READY
+
+
+def test_metrics_endpoint(stack):
+    base, _ = stack
+    r = requests.get(base + "/metrics", timeout=10)
+    assert r.status_code == 200
+    assert b"localai_api_calls_total" in r.content
+
+
+def test_kill9_backend_recovers(stack):
+    """Reference loader.go:191-225 semantics: dead backend is reaped on the
+    next request and respawned transparently."""
+    base, manager = stack
+    h = manager.get("tiny")
+    assert h is not None
+    os.kill(h.proc.pid, signal.SIGKILL)
+    h.proc.wait(timeout=10)
+    r = requests.post(base + "/v1/chat/completions", json={
+        "model": "tiny",
+        "messages": [{"role": "user", "content": "alive again"}],
+        "max_tokens": 3,
+    }, timeout=600)
+    assert r.status_code == 200, r.text
+    assert r.json()["usage"]["completion_tokens"] == 3
+    h2 = manager.get("tiny")
+    assert h2 is not None and h2.proc.pid != h.proc.pid
