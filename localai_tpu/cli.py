@@ -24,6 +24,8 @@ def _add_run(sub):
     p.add_argument("--watchdog-busy-timeout", default=None)
     p.add_argument("--single-active-backend", action="store_true")
     p.add_argument("--parallel-requests", type=int, default=8)
+    p.add_argument("--galleries", default=None,
+                   help="comma-separated gallery index YAMLs (path or URL)")
     p.add_argument("--log-level", default="info")
     return p
 

@@ -1,0 +1,1 @@
+from localai_tpu.downloader.uri import download_file, resolve_uri  # noqa: F401

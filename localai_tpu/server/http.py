@@ -70,6 +70,11 @@ class API:
         r.add_post("/tokenize", self._tokenize)
         r.add_get("/backend/monitor", self._backend_monitor)
         r.add_post("/backend/shutdown", self._backend_shutdown)
+        r.add_get("/system", self._system)
+        r.add_post("/models/apply", self._models_apply)
+        r.add_get("/models/available", self._models_available)
+        r.add_get("/models/jobs/{job_id}", self._models_job)
+        self.gallery_service = None  # wired by run_server when galleries set
 
     # ------------------------------------------------------------ middleware
 
@@ -386,6 +391,49 @@ class API:
             self.manager.stop_model, body.get("model", ""))
         return web.json_response({"success": ok})
 
+    async def _system(self, request):
+        from localai_tpu.system import system_info
+
+        info = await asyncio.to_thread(system_info)
+        info["loaded_models"] = self.manager.loaded()
+        return web.json_response(info)
+
+    # ------------------------------------------------------ gallery endpoints
+    # (reference routes: /models/apply + job status, localai.go)
+
+    def _require_gallery(self):
+        if self.gallery_service is None:
+            raise web.HTTPNotImplemented(
+                text=json.dumps(schema.error_body(
+                    "no galleries configured", code=501)),
+                content_type="application/json")
+        return self.gallery_service
+
+    async def _models_apply(self, request):
+        svc = self._require_gallery()
+        body = await request.json()
+        name = body.get("id") or body.get("model") or ""
+        job = svc.submit(name, overrides=body.get("config_overrides"))
+        return web.json_response({"uuid": job,
+                                  "status": f"/models/jobs/{job}"})
+
+    async def _models_available(self, request):
+        svc = self._require_gallery()
+        models = await asyncio.to_thread(svc.gallery.models)
+        return web.json_response([{
+            "name": m.name, "description": m.description, "tags": m.tags,
+            "installed": self.configs.get(m.name) is not None,
+        } for m in models.values()])
+
+    async def _models_job(self, request):
+        svc = self._require_gallery()
+        st = svc.status.get(request.match_info["job_id"])
+        if st is None:
+            raise web.HTTPNotFound()
+        if st.get("state") == "done":
+            self.configs.reload()  # new YAML becomes servable immediately
+        return web.json_response(st)
+
 
 def run_server(args) -> int:
     """CLI `run` entrypoint: assemble config + manager + API and serve."""
@@ -405,6 +453,15 @@ def run_server(args) -> int:
     manager = ModelManager(app_cfg)
     manager.start_watchdog()
     api = API(app_cfg, configs, manager)
+    galleries = getattr(args, "galleries", None)
+    if galleries:
+        from localai_tpu.services import Gallery, GalleryService
+
+        svc = GalleryService(
+            Gallery([s.strip() for s in galleries.split(",") if s.strip()]),
+            app_cfg.models_path)
+        svc.start()
+        api.gallery_service = svc
 
     host, _, port = app_cfg.address.rpartition(":")
     try:

@@ -1,0 +1,5 @@
+from localai_tpu.services.gallery import (  # noqa: F401
+    Gallery,
+    GalleryService,
+    install_model,
+)

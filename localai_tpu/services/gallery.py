@@ -1,0 +1,186 @@
+"""Model gallery: remote/local YAML index → download artifacts + write model
+YAML into the models dir.
+
+Reference: /root/reference/core/gallery/models.go:75-285 (resolve from index,
+download files with sha256+progress, write per-model config),
+core/services/gallery.go:116-166 (serialized job queue with status map).
+Galleries are YAML lists of entries:
+
+  - name: tinyllama-chat
+    description: ...
+    files:
+      - filename: model/config.json
+        uri: file:///path/or/https://...
+        sha256: ...
+    config:            # ModelConfig overrides written to <name>.yaml
+      backend: llm
+      parameters: {model: tinyllama-chat/model}
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import queue
+import threading
+import uuid
+from typing import Any
+
+import yaml
+
+from localai_tpu.downloader import download_file
+
+
+@dataclasses.dataclass
+class GalleryModel:
+    name: str
+    description: str = ""
+    license: str = ""
+    urls: list[str] = dataclasses.field(default_factory=list)
+    tags: list[str] = dataclasses.field(default_factory=list)
+    files: list[dict] = dataclasses.field(default_factory=list)
+    config: dict = dataclasses.field(default_factory=dict)
+    gallery: str = ""
+
+
+class Gallery:
+    """One or more gallery indexes (local path or URL of a YAML list)."""
+
+    def __init__(self, sources: list[str]):
+        self.sources = sources
+        self._models: dict[str, GalleryModel] | None = None
+
+    def _fetch_index(self, src: str) -> list[dict]:
+        if "://" in src and not src.startswith("file://"):
+            import tempfile
+
+            with tempfile.NamedTemporaryFile(suffix=".yaml") as t:
+                download_file(src, t.name)
+                with open(t.name) as f:
+                    return yaml.safe_load(f) or []
+        path = src.removeprefix("file://")
+        with open(path) as f:
+            return yaml.safe_load(f) or []
+
+    def models(self) -> dict[str, GalleryModel]:
+        if self._models is None:
+            out: dict[str, GalleryModel] = {}
+            for src in self.sources:
+                for entry in self._fetch_index(src):
+                    known = {f.name for f in dataclasses.fields(GalleryModel)}
+                    gm = GalleryModel(**{k: v for k, v in entry.items()
+                                         if k in known})
+                    gm.gallery = src
+                    out[gm.name] = gm
+            self._models = out
+        return self._models
+
+    def get(self, name: str) -> GalleryModel | None:
+        return self.models().get(name)
+
+
+def install_model(gallery: Gallery, name: str, models_path: str,
+                  progress=None, overrides: dict | None = None) -> str:
+    """Download a gallery model's files and write its ModelConfig YAML.
+    Returns the YAML path (models.go:159-285 semantics)."""
+    gm = gallery.get(name)
+    if gm is None:
+        raise KeyError(f"model {name!r} not in galleries")
+    os.makedirs(models_path, exist_ok=True)
+    for f in gm.files:
+        dest = os.path.join(models_path, f["filename"])
+        if os.path.realpath(dest).startswith(os.path.realpath("/")) and \
+                ".." in f["filename"]:
+            raise ValueError(f"path traversal in gallery file {f['filename']!r}")
+        download_file(f["uri"], dest, sha256=f.get("sha256"),
+                      progress=progress)
+    cfg: dict[str, Any] = {"name": name,
+                           "description": gm.description}
+    cfg.update(gm.config or {})
+    cfg.update(overrides or {})
+    cfg.setdefault("name", name)
+    ypath = os.path.join(models_path, f"{name}.yaml")
+    with open(ypath, "w") as f:
+        yaml.safe_dump(cfg, f, sort_keys=False)
+    return ypath
+
+
+class GalleryService:
+    """Serialized install job queue with UUID status map
+    (services/gallery.go:116-166)."""
+
+    def __init__(self, gallery: Gallery, models_path: str):
+        self.gallery = gallery
+        self.models_path = models_path
+        self._jobs: "queue.Queue[tuple[str, str, dict | None]]" = queue.Queue()
+        self.status: dict[str, dict] = {}
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+
+    def start(self):
+        if self._thread:
+            return
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        self._jobs.put(("", "", None))  # wake
+        if self._thread:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def submit(self, model_name: str, overrides: dict | None = None) -> str:
+        job_id = uuid.uuid4().hex
+        self.status[job_id] = {"state": "queued", "model": model_name,
+                               "progress": 0.0, "error": ""}
+        self._jobs.put((job_id, model_name, overrides))
+        return job_id
+
+    def _loop(self):
+        while not self._stop.is_set():
+            job_id, name, overrides = self._jobs.get()
+            if not job_id:
+                continue
+            st = self.status[job_id]
+            st["state"] = "processing"
+
+            def progress(done, total, st=st):
+                st["progress"] = done / total if total else 0.0
+
+            try:
+                path = install_model(self.gallery, name, self.models_path,
+                                     progress=progress, overrides=overrides)
+                st.update(state="done", progress=1.0, config=path)
+            except Exception as e:
+                st.update(state="error", error=f"{type(e).__name__}: {e}")
+
+
+def cli_models(args) -> int:
+    """`localai-tpu models list|install` (reference core/cli models cmd)."""
+    from localai_tpu.config import ModelConfigLoader
+
+    sources = []
+    if getattr(args, "galleries", None):
+        sources = [s.strip() for s in args.galleries.split(",") if s.strip()]
+    gallery = Gallery(sources) if sources else None
+
+    if args.action == "list":
+        loader = ModelConfigLoader(args.models_path)
+        for n in loader.names():
+            print(f"{n} (installed)")
+        if gallery:
+            for n in sorted(gallery.models()):
+                print(n)
+        return 0
+    if args.action == "install":
+        if not args.name:
+            print("usage: models install <name>")
+            return 1
+        if gallery is None:
+            print("no galleries configured (--galleries)")
+            return 1
+        path = install_model(gallery, args.name, args.models_path,
+                             progress=lambda d, t: None)
+        print(f"installed → {path}")
+        return 0
+    return 1

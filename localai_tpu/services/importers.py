@@ -1,0 +1,48 @@
+"""Importers: guess a ModelConfig from a bare checkpoint directory/URI.
+
+Reference: /root/reference/core/gallery/importers (per-backend-family config
+guessers) + core/config/guesser.go:11-46 (fill missing knobs from model
+metadata). Here the metadata source is HF config.json instead of GGUF headers.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+# architectures the TPU llm engine serves (engine/loader.py LLAMA_FAMILY)
+_LLM_ARCHS = {
+    "LlamaForCausalLM", "MistralForCausalLM", "Qwen2ForCausalLM",
+    "TinyLlamaForCausalLM",
+}
+_WHISPER_ARCHS = {"WhisperForConditionalGeneration"}
+
+
+def guess_model_config(model_dir: str, name: str | None = None) -> dict[str, Any]:
+    """Inspect a checkpoint dir → ModelConfig dict (ready for YAML dump)."""
+    cfg_path = os.path.join(model_dir, "config.json")
+    if not os.path.exists(cfg_path):
+        raise FileNotFoundError(f"no config.json in {model_dir}")
+    with open(cfg_path) as f:
+        hf = json.load(f)
+    arch = (hf.get("architectures") or [""])[0]
+    name = name or os.path.basename(os.path.normpath(model_dir))
+
+    out: dict[str, Any] = {
+        "name": name,
+        "parameters": {"model": model_dir},
+    }
+    if arch in _WHISPER_ARCHS:
+        out["backend"] = "whisper"
+        return out
+    if arch in _LLM_ARCHS or "hidden_size" in hf:
+        out["backend"] = "llm"
+        maxpos = hf.get("max_position_embeddings")
+        if maxpos:
+            out["context_size"] = min(int(maxpos), 8192)
+        # small models → likely used for embeddings too
+        if hf.get("hidden_size", 4096) <= 1024:
+            out["embeddings"] = True
+        out["template"] = {"use_tokenizer_template": True}
+        return out
+    raise ValueError(f"unsupported architecture {arch!r} in {model_dir}")

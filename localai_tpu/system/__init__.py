@@ -1,0 +1,4 @@
+from localai_tpu.system.capabilities import (  # noqa: F401
+    detect_capability,
+    system_info,
+)

@@ -1,0 +1,64 @@
+"""Hardware capability detection (reference: /root/reference/pkg/system/
+capabilities.go:28-99 — GPU vendor → capability string used to pick concrete
+backends, force-file override :49-64; sysinfo pkg/xsysinfo).
+
+TPU build: capability keys are `tpu-v4|tpu-v5e|tpu-v5p|tpu-v6e|cpu`, detected
+from the attached JAX device (lazily — detection must not initialize a TPU
+client at import time)."""
+from __future__ import annotations
+
+import functools
+import os
+
+
+CAPABILITY_FORCE_FILE = "/run/localai/capability"
+
+
+@functools.lru_cache(maxsize=1)
+def detect_capability() -> str:
+    # force-file override wins (capabilities.go:49-64)
+    if os.path.exists(CAPABILITY_FORCE_FILE):
+        with open(CAPABILITY_FORCE_FILE) as f:
+            forced = f.read().strip()
+        if forced:
+            return forced
+    if os.environ.get("LOCALAI_FORCE_CAPABILITY"):
+        return os.environ["LOCALAI_FORCE_CAPABILITY"]
+    try:
+        import jax
+
+        d = jax.devices()[0]
+        kind = getattr(d, "device_kind", "").lower()
+        if d.platform == "cpu":
+            return "cpu"
+        for tag in ("v6e", "v5p", "v5e", "v5", "v4"):
+            if tag in kind:
+                return f"tpu-{'v5e' if tag == 'v5' else tag}"
+        return "tpu"
+    except Exception:
+        return "cpu"
+
+
+def system_info() -> dict:
+    """CPU/memory/accelerator summary (xsysinfo role)."""
+    info: dict = {"capability": detect_capability()}
+    try:
+        info["cpu_count"] = os.cpu_count()
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal"):
+                    info["mem_total_kb"] = int(line.split()[1])
+                    break
+    except OSError:
+        pass
+    try:
+        import jax
+
+        info["devices"] = [
+            {"id": d.id, "platform": d.platform,
+             "kind": getattr(d, "device_kind", "")}
+            for d in jax.devices()
+        ]
+    except Exception:
+        info["devices"] = []
+    return info
