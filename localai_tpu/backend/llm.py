@@ -138,6 +138,7 @@ class LLMServicer(BackendServicer):
             stop=tuple(request.stop_prompts),
             ignore_eos=request.ignore_eos,
             logprobs=request.logprobs,
+            grammar=request.grammar,
         )
         try:
             return self.engine.submit(req)

@@ -73,6 +73,7 @@ class GenRequest:
     stop: tuple[str, ...] = ()
     ignore_eos: bool = False
     logprobs: bool = False
+    grammar: str = ""             # GBNF; enforced via native matcher masks
 
 
 @dataclasses.dataclass
@@ -95,6 +96,7 @@ class _Slot:
     out: queue.Queue
     detok: Any                       # _IncrementalDecoder | None
     pending_text: str = ""           # holdback buffer for stop-string scan
+    matcher: Any = None              # grammar MatcherState | None
     generated: int = 0
     gen_ids: list[int] = dataclasses.field(default_factory=list)
     start_time: float = 0.0
@@ -133,6 +135,12 @@ class Engine:
             self._sampler = SamplerState.init(B, V)
             self._last_logits = jnp.zeros((B, V), jnp.float32)
             self._lengths = jnp.zeros((B,), jnp.int32)
+
+        # grammar masks: one bitmask row per slot, all-ones = unconstrained
+        self._mask_nbytes = (V + 7) // 8
+        self._mask_host = np.full((B, self._mask_nbytes), 0xFF, np.uint8)
+        self._grammar_slots = 0
+        self._grammar_cache = None
 
         # host-side slot table
         self._slots: list[_Slot | None] = [None] * B
@@ -179,9 +187,9 @@ class Engine:
             return kc, vc, SamplerState(**new_fields), last_logits, lengths
 
         def _decode(params, cos, sin, kc, vc, sampler, last_logits, lengths,
-                    active):
+                    active, mask_bits):
             """sample(prev logits) → decode → next logits, for all slots."""
-            tokens, keys, logprobs = sample(last_logits, sampler)
+            tokens, keys, logprobs = sample(last_logits, sampler, mask_bits)
             logits, kc, vc = decode_step(
                 params, cfg, tokens, lengths, cos, sin, kc, vc
             )
@@ -195,9 +203,14 @@ class Engine:
             lengths = lengths + act
             return tokens, logprobs, kc, vc, sampler, logits, lengths
 
-        # donate the big carried buffers: cache stays in place in HBM
+        # donate the big carried buffers: cache stays in place in HBM.
+        # mask_bits=None compiles a no-grammar variant with zero extra
+        # host→device traffic on the common path.
         self._admit_fn = jax.jit(_admit, donate_argnums=(3, 4, 5, 6, 7))
-        self._decode_fn = jax.jit(_decode, donate_argnums=(3, 4, 5, 6, 7))
+        self._decode_fn = jax.jit(_decode, donate_argnums=(3, 4, 5, 6, 7),
+                                  static_argnames=())
+        self._decode_nomask_fn = jax.jit(
+            partial(_decode, mask_bits=None), donate_argnums=(3, 4, 5, 6, 7))
 
     # ------------------------------------------------------------ submission
 
@@ -231,7 +244,17 @@ class Engine:
                 return b
         raise ValueError(f"prompt too long: {n}")
 
+    def _matcher_for(self, grammar: str):
+        if self._grammar_cache is None:
+            if self.tok is None:
+                raise ValueError("grammar constraint requires a tokenizer")
+            from localai_tpu.functions.matcher import GrammarCache
+
+            self._grammar_cache = GrammarCache(self.tok)
+        return self._grammar_cache.get(grammar).state()
+
     def _admit_one(self, rid: int, req: GenRequest, out: queue.Queue):
+        matcher = self._matcher_for(req.grammar) if req.grammar else None
         slot = self._free.pop()
         n = len(req.prompt_ids)
         bucket = self._bucket(n)
@@ -255,8 +278,13 @@ class Engine:
         self._slots[slot] = _Slot(
             request_id=rid, req=req, out=out,
             detok=self.tok.stream_decoder() if self.tok else None,
+            matcher=matcher,
             start_time=time.monotonic(), prompt_len=n,
         )
+        if matcher is not None:
+            eos = self.tok.eos_ids if self.tok else ()
+            self._mask_host[slot] = matcher.mask_bits(eos)
+            self._grammar_slots += 1
         self.metrics["prompt_tokens_processed"] += n
 
     def _active_mask(self) -> np.ndarray:
@@ -278,12 +306,17 @@ class Engine:
             return False
 
         with activate_mesh(self.mesh):
-            (tokens, logprobs, self._kc, self._vc, self._sampler,
-             self._last_logits, self._lengths) = self._decode_fn(
-                self.params, self._cos, self._sin,
-                self._kc, self._vc, self._sampler, self._last_logits,
-                self._lengths, jnp.asarray(active),
-            )
+            args = (self.params, self._cos, self._sin,
+                    self._kc, self._vc, self._sampler, self._last_logits,
+                    self._lengths, jnp.asarray(active))
+            if self._grammar_slots > 0:
+                (tokens, logprobs, self._kc, self._vc, self._sampler,
+                 self._last_logits, self._lengths) = self._decode_fn(
+                    *args, jnp.asarray(self._mask_host))
+            else:
+                (tokens, logprobs, self._kc, self._vc, self._sampler,
+                 self._last_logits, self._lengths) = self._decode_nomask_fn(
+                    *args)
         tokens = np.asarray(jax.device_get(tokens))
         logprobs = np.asarray(jax.device_get(logprobs))
 
@@ -311,6 +344,17 @@ class Engine:
             finish = "length"
         elif slot.prompt_len + slot.generated >= self.ec.max_context - 1:
             finish = "length"
+
+        # grammar: advance the PDA with the sampled token, refresh the mask
+        if slot.matcher is not None and finish is None:
+            eos = self.tok.eos_ids if self.tok else ()
+            if slot.matcher.accept(token_id):
+                self._mask_host[idx] = slot.matcher.mask_bits(eos)
+                if (slot.matcher.done and not slot.matcher.can_continue
+                        and not eos):
+                    finish = "stop"  # complete and nothing can follow
+            else:
+                finish = "stop"  # defensive: mask should prevent this
 
         text = ""
         if slot.detok is not None:
@@ -351,8 +395,14 @@ class Engine:
             if dur > 0:
                 self.metrics["tokens_per_second_last"] = slot.generated / dur
             self.metrics["requests_completed"] += 1
-            self._slots[idx] = None
-            self._free.append(idx)
+            self._release_slot(idx, slot)
+
+    def _release_slot(self, idx: int, slot: _Slot):
+        if slot.matcher is not None:
+            self._mask_host[idx] = 0xFF
+            self._grammar_slots -= 1
+        self._slots[idx] = None
+        self._free.append(idx)
 
     # ------------------------------------------------------------ run modes
 
@@ -391,8 +441,7 @@ class Engine:
                 finished=True, finish_reason=reason,
                 generated_tokens=slot.generated, prompt_tokens=slot.prompt_len,
             ))
-            self._slots[i] = None
-            self._free.append(i)
+            self._release_slot(i, slot)
         while True:
             try:
                 rid, req, out = self._queue.get_nowait()

@@ -1,0 +1,189 @@
+"""Grammar matcher binding: GBNF → native PDA → per-step token bitmasks.
+
+Host/device split (the TPU answer to llama.cpp's sampler-integrated grammar):
+the native lib (localai_tpu/native/grammar.cpp) tracks the parse state and
+produces a [ceil(V/8)]-byte allowed-token bitmask; the engine uploads masks
+for constrained slots each step and the jitted sampler applies them before
+top-k/top-p (ops/sampling.sample).
+"""
+from __future__ import annotations
+
+import ctypes
+import functools
+import json
+
+import numpy as np
+
+from localai_tpu.native import build_and_load
+
+
+@functools.lru_cache(maxsize=8)
+def _lib():
+    lib = build_and_load("grammar")
+    lib.gm_compile.restype = ctypes.c_void_p
+    lib.gm_compile.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
+    lib.gm_set_vocab.restype = ctypes.c_int
+    lib.gm_set_vocab.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                 ctypes.POINTER(ctypes.c_int64), ctypes.c_int]
+    lib.gm_state_new.restype = ctypes.c_void_p
+    lib.gm_state_new.argtypes = [ctypes.c_void_p]
+    lib.gm_state_accept_token.restype = ctypes.c_int
+    lib.gm_state_accept_token.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.gm_state_mask.restype = ctypes.c_int
+    lib.gm_state_mask.argtypes = [ctypes.c_void_p,
+                                  ctypes.POINTER(ctypes.c_uint8), ctypes.c_int]
+    lib.gm_state_done.restype = ctypes.c_int
+    lib.gm_state_done.argtypes = [ctypes.c_void_p]
+    lib.gm_state_can_continue.restype = ctypes.c_int
+    lib.gm_state_can_continue.argtypes = [ctypes.c_void_p]
+    lib.gm_state_free.argtypes = [ctypes.c_void_p]
+    lib.gm_free.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+# ------------------------------------------------------------ token texts
+
+_BYTELEVEL_DECODER: dict[str, int] | None = None
+
+
+def _bytelevel_table() -> dict[str, int]:
+    """GPT-2 bytes↔unicode mapping (chars used by ByteLevel tokenizers)."""
+    global _BYTELEVEL_DECODER
+    if _BYTELEVEL_DECODER is None:
+        bs = (list(range(ord("!"), ord("~") + 1))
+              + list(range(0xA1, 0xAD)) + list(range(0xAE, 0x100)))
+        cs = bs[:]
+        n = 0
+        for b in range(256):
+            if b not in bs:
+                bs.append(b)
+                cs.append(256 + n)
+                n += 1
+        _BYTELEVEL_DECODER = {chr(c): b for b, c in zip(bs, cs)}
+    return _BYTELEVEL_DECODER
+
+
+def token_texts(tok) -> list[str]:
+    """Raw text each vocab id contributes mid-sequence. Handles ByteLevel
+    (byte-alphabet remap; tokens with partial UTF-8 → ''), Metaspace (▁→space)
+    and WordPiece (## continuation)."""
+    hf = tok._tok
+    try:
+        spec = json.loads(hf.to_str())
+        dec = (spec.get("decoder") or {})
+        dtypes = [dec.get("type")] + [
+            d.get("type") for d in dec.get("decoders", []) or []
+        ]
+    except Exception:
+        dtypes = [None]
+
+    vocab_size = hf.get_vocab_size()
+    out = [""] * vocab_size
+    table = _bytelevel_table()
+    for i in range(vocab_size):
+        t = hf.id_to_token(i)
+        if t is None:
+            continue
+        if "ByteLevel" in dtypes:
+            try:
+                raw = bytes(table[c] for c in t)
+            except KeyError:
+                out[i] = ""  # special token — never allowed by a grammar
+                continue
+            try:
+                out[i] = raw.decode("utf-8")
+            except UnicodeDecodeError:
+                out[i] = ""  # partial multi-byte sequence
+        elif "Metaspace" in dtypes:
+            out[i] = t.replace("▁", " ")
+        elif "WordPiece" in dtypes:
+            out[i] = t[2:] if t.startswith("##") else t
+        else:
+            out[i] = t
+    return out
+
+
+class CompiledGrammar:
+    """A grammar compiled against a tokenizer's vocabulary."""
+
+    def __init__(self, gbnf: str, token_strings: list[str]):
+        lib = _lib()
+        err = ctypes.create_string_buffer(256)
+        self._g = lib.gm_compile(gbnf.encode(), err, 256)
+        if not self._g:
+            raise ValueError(f"grammar parse error: {err.value.decode()}")
+        self.vocab_size = len(token_strings)
+        self.nbytes = (self.vocab_size + 7) // 8
+        blob = b"".join(s.encode() for s in token_strings)
+        offsets = np.zeros(self.vocab_size + 1, np.int64)
+        o = 0
+        for i, s in enumerate(token_strings):
+            offsets[i] = o
+            o += len(s.encode())
+        offsets[self.vocab_size] = o
+        lib.gm_set_vocab(
+            self._g, blob,
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            self.vocab_size)
+        self._lib = lib
+
+    def state(self) -> "MatcherState":
+        return MatcherState(self)
+
+    def __del__(self):
+        if getattr(self, "_g", None):
+            self._lib.gm_free(self._g)
+            self._g = None
+
+
+class GrammarCache:
+    """Per-tokenizer cache of compiled grammars (token_texts is computed
+    once; grammar compiles are memoized by text)."""
+
+    def __init__(self, tok):
+        self._texts = token_texts(tok)
+        self._cache: dict[str, CompiledGrammar] = {}
+
+    def get(self, gbnf: str) -> CompiledGrammar:
+        g = self._cache.get(gbnf)
+        if g is None:
+            g = CompiledGrammar(gbnf, self._texts)
+            if len(self._cache) > 32:
+                self._cache.clear()
+            self._cache[gbnf] = g
+        return g
+
+
+class MatcherState:
+    def __init__(self, grammar: CompiledGrammar):
+        self.g = grammar
+        self._s = grammar._lib.gm_state_new(grammar._g)
+
+    def accept(self, token_id: int) -> bool:
+        return bool(self.g._lib.gm_state_accept_token(self._s, token_id))
+
+    def mask_bits(self, eos_ids=()) -> np.ndarray:
+        """Allowed-token bitmask [nbytes] u8; EOS bits set iff the grammar
+        can complete here."""
+        bits = np.zeros(self.g.nbytes, np.uint8)
+        self.g._lib.gm_state_mask(
+            self._s, bits.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            self.g.nbytes)
+        if self.done:
+            for e in eos_ids:
+                if 0 <= e < self.g.vocab_size:
+                    bits[e >> 3] |= 1 << (e & 7)
+        return bits
+
+    @property
+    def done(self) -> bool:
+        return bool(self.g._lib.gm_state_done(self._s))
+
+    @property
+    def can_continue(self) -> bool:
+        return bool(self.g._lib.gm_state_can_continue(self._s))
+
+    def __del__(self):
+        if getattr(self, "_s", None):
+            self.g._lib.gm_state_free(self._s)
+            self._s = None

@@ -1,0 +1,36 @@
+"""Native (C++) components, built on demand with the in-image toolchain.
+
+The reference ships native code for its hot host-side paths (llama.cpp server,
+grammar sampler, local-store); here the native tier is compiled lazily at
+first use (g++ -O2 -shared) and cached next to the source. ctypes bindings —
+no pybind11 in the image.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(__file__)
+_LOCK = threading.Lock()
+_LIBS: dict[str, ctypes.CDLL] = {}
+
+
+def build_and_load(name: str) -> ctypes.CDLL:
+    """Compile native/<name>.cpp → lib<name>.so (if stale) and dlopen it."""
+    with _LOCK:
+        if name in _LIBS:
+            return _LIBS[name]
+        src = os.path.join(_HERE, f"{name}.cpp")
+        lib = os.path.join(_HERE, f"lib{name}.so")
+        if (not os.path.exists(lib)
+                or os.path.getmtime(lib) < os.path.getmtime(src)):
+            cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                   "-o", lib + ".tmp", src]
+            r = subprocess.run(cmd, capture_output=True, text=True)
+            if r.returncode != 0:
+                raise RuntimeError(f"native build of {name} failed:\n{r.stderr}")
+            os.replace(lib + ".tmp", lib)
+        _LIBS[name] = ctypes.CDLL(lib)
+        return _LIBS[name]

@@ -128,13 +128,21 @@ def apply_penalties(logits, state: SamplerState):
     return logits
 
 
-def sample(logits, state: SamplerState):
+def sample(logits, state: SamplerState, mask_bits=None):
     """One sampling step. logits: [B, V] (any float dtype).
+
+    mask_bits: optional [B, ceil(V/8)] u8 allowed-token bitmask (LSB-first)
+    from the grammar matcher — disallowed tokens are hard-masked before the
+    truncation chain (the llama.cpp grammar-sampler role, applied on-device).
 
     Returns (tokens [B] i32, new_keys [B,2], logprobs [B] f32 of chosen token).
     """
     b, v = logits.shape
     logits = logits.astype(jnp.float32)
+    if mask_bits is not None:
+        bits = (mask_bits[:, :, None] >> jnp.arange(8, dtype=jnp.uint8)) & 1
+        allowed = bits.reshape(b, -1)[:, :v].astype(bool)
+        logits = jnp.where(allowed, logits, NEG_INF)
     logits = apply_penalties(logits, state)
     logits = logits + state.logit_bias
     logits = logits / jnp.maximum(state.temperature[:, None], 1e-6)

@@ -190,6 +190,25 @@ def test_metrics_endpoint(stack):
     assert b"localai_api_calls_total" in r.content
 
 
+def test_response_format_json_object(stack):
+    """response_format=json_object → grammar-enforced valid JSON output even
+    from random weights (chat.go:224-258 semantics, enforced on-device)."""
+    base, _ = stack
+    r = requests.post(base + "/v1/chat/completions", json={
+        "model": "tiny",
+        "messages": [{"role": "user", "content": "emit json"}],
+        "max_tokens": 50,
+        "temperature": 0.9,
+        "seed": 11,
+        "response_format": {"type": "json_object"},
+    }, timeout=300)
+    assert r.status_code == 200, r.text
+    content = r.json()["choices"][0]["message"]["content"]
+    assert content.startswith("{")
+    if r.json()["choices"][0]["finish_reason"] in ("stop", "eos"):
+        json.loads(content)
+
+
 def test_kill9_backend_recovers(stack):
     """Reference loader.go:191-225 semantics: dead backend is reaped on the
     next request and respawned transparently."""
