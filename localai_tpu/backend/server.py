@@ -36,6 +36,13 @@ def _make_llm():
     return LLMServicer()
 
 
+@_role("store")
+def _make_store():
+    from localai_tpu.backend.store import StoreServicer
+
+    return StoreServicer()
+
+
 @_role("base")
 def _make_base():
     return BackendServicer()

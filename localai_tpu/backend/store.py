@@ -1,0 +1,76 @@
+"""Stores backend servicer — the local-store backend process
+(/root/reference/backend/go/local-store/store.go Set/Get/Delete/Find RPCs)
+over the native C++ store."""
+from __future__ import annotations
+
+import grpc
+
+from localai_tpu.backend import pb
+from localai_tpu.backend.base import BackendServicer
+
+
+class StoreServicer(BackendServicer):
+    def __init__(self):
+        self.store = None
+
+    def LoadModel(self, request, context):
+        # store needs no model; dim fixed on first Set
+        return pb.Result(success=True, message="ok")
+
+    def _ensure(self, dim: int, context):
+        from localai_tpu.stores import LocalStore
+
+        if self.store is None:
+            self.store = LocalStore(dim)
+        elif self.store.dim != dim:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          f"key dim {dim} != store dim {self.store.dim}")
+        return self.store
+
+    def StoresSet(self, request, context):
+        if len(request.keys) != len(request.values):
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          "keys/values length mismatch")
+        if not request.keys:
+            return pb.Result(success=True)
+        st = self._ensure(len(request.keys[0].floats), context)
+        import numpy as np
+
+        keys = np.array([list(k.floats) for k in request.keys], np.float32)
+        st.set(keys, [v.bytes for v in request.values])
+        return pb.Result(success=True)
+
+    def StoresDelete(self, request, context):
+        if not request.keys or self.store is None:
+            return pb.Result(success=True)
+        import numpy as np
+
+        keys = np.array([list(k.floats) for k in request.keys], np.float32)
+        self.store.delete(keys)
+        return pb.Result(success=True)
+
+    def StoresGet(self, request, context):
+        resp = pb.StoresGetResult()
+        if self.store is None:
+            return resp
+        import numpy as np
+
+        keys = np.array([list(k.floats) for k in request.keys], np.float32)
+        for k, v in zip(request.keys, self.store.get(keys)):
+            if v is None:
+                continue
+            resp.keys.append(k)
+            resp.values.append(pb.StoresValue(bytes=v))
+        return resp
+
+    def StoresFind(self, request, context):
+        resp = pb.StoresFindResult()
+        if self.store is None:
+            return resp
+        keys, vals, sims = self.store.find(
+            list(request.key.floats), max(request.top_k, 1))
+        for i in range(len(vals)):
+            resp.keys.append(pb.StoresKey(floats=keys[i].tolist()))
+            resp.values.append(pb.StoresValue(bytes=vals[i]))
+            resp.similarities.append(float(sims[i]))
+        return resp

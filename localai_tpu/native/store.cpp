@@ -1,0 +1,151 @@
+// In-memory vector store with cosine top-k — the native tier of the Stores
+// backend (role of /root/reference/backend/go/local-store/store.go:110-515:
+// sorted keys, normalized fast path, priority-queue top-k).
+//
+// Design: flat row-major float matrix + byte values; exact-key lookup via a
+// hash of the raw float bits; all vectors stored L2-normalized alongside the
+// originals so Find is one GEMV + partial_sort. ctypes C API.
+//
+// Build: g++ -O2 -std=c++17 -shared -fPIC -o libstore.so store.cpp
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct KeyHash {
+  size_t operator()(const std::string& s) const {
+    return std::hash<std::string>()(s);
+  }
+};
+
+struct Store {
+  int dim;
+  std::vector<float> keys;        // [n, dim] originals
+  std::vector<float> unit;        // [n, dim] L2-normalized
+  std::vector<std::string> values;
+  std::unordered_map<std::string, int> index;  // raw key bytes → row
+  std::vector<int> free_rows;
+
+  std::string key_bytes(const float* k) const {
+    return std::string(reinterpret_cast<const char*>(k), dim * sizeof(float));
+  }
+
+  void write_row(int row, const float* k, const uint8_t* v, int64_t vlen) {
+    std::memcpy(&keys[(size_t)row * dim], k, dim * sizeof(float));
+    double norm = 0;
+    for (int i = 0; i < dim; i++) norm += (double)k[i] * k[i];
+    float inv = norm > 0 ? (float)(1.0 / std::sqrt(norm)) : 0.f;
+    for (int i = 0; i < dim; i++) unit[(size_t)row * dim + i] = k[i] * inv;
+    values[row].assign(reinterpret_cast<const char*>(v), vlen);
+  }
+
+  int upsert(const float* k, const uint8_t* v, int64_t vlen) {
+    auto kb = key_bytes(k);
+    auto it = index.find(kb);
+    if (it != index.end()) {
+      write_row(it->second, k, v, vlen);
+      return it->second;
+    }
+    int row;
+    if (!free_rows.empty()) {
+      row = free_rows.back();
+      free_rows.pop_back();
+    } else {
+      row = (int)(keys.size() / dim);
+      keys.resize(keys.size() + dim);
+      unit.resize(unit.size() + dim);
+      values.emplace_back();
+    }
+    write_row(row, k, v, vlen);
+    index[kb] = row;
+    return row;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+Store* st_new(int dim) {
+  auto* s = new Store();
+  s->dim = dim;
+  return s;
+}
+
+void st_free(Store* s) { delete s; }
+
+int st_count(Store* s) { return (int)s->index.size(); }
+int st_dim(Store* s) { return s->dim; }
+
+int st_set(Store* s, int n, const float* keys, const uint8_t* blob,
+           const int64_t* offsets) {
+  for (int i = 0; i < n; i++)
+    s->upsert(keys + (size_t)i * s->dim, blob + offsets[i],
+              offsets[i + 1] - offsets[i]);
+  return n;
+}
+
+int st_delete(Store* s, int n, const float* keys) {
+  int deleted = 0;
+  for (int i = 0; i < n; i++) {
+    auto it = s->index.find(s->key_bytes(keys + (size_t)i * s->dim));
+    if (it == s->index.end()) continue;
+    s->free_rows.push_back(it->second);
+    s->values[it->second].clear();
+    s->index.erase(it);
+    deleted++;
+  }
+  return deleted;
+}
+
+// returns row id or -1
+int st_lookup(Store* s, const float* key) {
+  auto it = s->index.find(s->key_bytes(key));
+  return it == s->index.end() ? -1 : it->second;
+}
+
+int64_t st_value_len(Store* s, int row) {
+  return (int64_t)s->values[row].size();
+}
+
+void st_value_copy(Store* s, int row, uint8_t* out) {
+  std::memcpy(out, s->values[row].data(), s->values[row].size());
+}
+
+void st_key_copy(Store* s, int row, float* out) {
+  std::memcpy(out, &s->keys[(size_t)row * s->dim], s->dim * sizeof(float));
+}
+
+// cosine top-k over live rows; returns m <= k, fills rows + similarities
+int st_find(Store* s, const float* key, int k, int* out_rows,
+            float* out_sims) {
+  double norm = 0;
+  for (int i = 0; i < s->dim; i++) norm += (double)key[i] * key[i];
+  float inv = norm > 0 ? (float)(1.0 / std::sqrt(norm)) : 0.f;
+  std::vector<float> q(s->dim);
+  for (int i = 0; i < s->dim; i++) q[i] = key[i] * inv;
+
+  std::vector<std::pair<float, int>> scored;
+  scored.reserve(s->index.size());
+  for (const auto& [kb, row] : s->index) {
+    const float* u = &s->unit[(size_t)row * s->dim];
+    float dot = 0;
+    for (int i = 0; i < s->dim; i++) dot += q[i] * u[i];
+    scored.emplace_back(dot, row);
+  }
+  int m = std::min<int>(k, (int)scored.size());
+  std::partial_sort(scored.begin(), scored.begin() + m, scored.end(),
+                    [](auto& a, auto& b) { return a.first > b.first; });
+  for (int i = 0; i < m; i++) {
+    out_rows[i] = scored[i].second;
+    out_sims[i] = scored[i].first;
+  }
+  return m;
+}
+
+}  // extern "C"

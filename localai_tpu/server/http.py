@@ -71,6 +71,10 @@ class API:
         r.add_get("/backend/monitor", self._backend_monitor)
         r.add_post("/backend/shutdown", self._backend_shutdown)
         r.add_get("/system", self._system)
+        r.add_post("/stores/set", self._stores_set)
+        r.add_post("/stores/get", self._stores_get)
+        r.add_post("/stores/delete", self._stores_delete)
+        r.add_post("/stores/find", self._stores_find)
         r.add_post("/models/apply", self._models_apply)
         r.add_get("/models/available", self._models_available)
         r.add_get("/models/jobs/{job_id}", self._models_job)
@@ -390,6 +394,53 @@ class API:
         ok = await asyncio.to_thread(
             self.manager.stop_model, body.get("model", ""))
         return web.json_response({"success": ok})
+
+    # ------------------------------------------------------ stores endpoints
+    # (reference: localai routes + backend/go/local-store; values are strings
+    # on the wire, bytes at the backend)
+
+    async def _store_handle(self, body: dict):
+        name = body.get("store") or "default-store"
+        cfg = self.configs.get(name)
+        if cfg is None:
+            cfg = ModelConfig(name=name, backend="store")
+        return await self._handle(cfg)
+
+    async def _stores_set(self, request):
+        body = await request.json()
+        h = await self._store_handle(body)
+        await asyncio.to_thread(lambda: h.client.stores_set(
+            body.get("keys", []),
+            [v.encode() for v in body.get("values", [])]))
+        return web.json_response({})
+
+    async def _stores_get(self, request):
+        body = await request.json()
+        h = await self._store_handle(body)
+        r = await asyncio.to_thread(
+            lambda: h.client.stores_get(body.get("keys", [])))
+        return web.json_response({
+            "keys": [list(k.floats) for k in r.keys],
+            "values": [v.bytes.decode("utf-8", "replace") for v in r.values],
+        })
+
+    async def _stores_delete(self, request):
+        body = await request.json()
+        h = await self._store_handle(body)
+        await asyncio.to_thread(
+            lambda: h.client.stores_delete(body.get("keys", [])))
+        return web.json_response({})
+
+    async def _stores_find(self, request):
+        body = await request.json()
+        h = await self._store_handle(body)
+        r = await asyncio.to_thread(lambda: h.client.stores_find(
+            body.get("key", []), int(body.get("topk", 10))))
+        return web.json_response({
+            "keys": [list(k.floats) for k in r.keys],
+            "values": [v.bytes.decode("utf-8", "replace") for v in r.values],
+            "similarities": list(r.similarities),
+        })
 
     async def _system(self, request):
         from localai_tpu.system import system_info

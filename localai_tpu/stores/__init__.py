@@ -1,0 +1,119 @@
+"""Vector store: Python wrapper over the native C++ cosine-top-k store
+(reference client role: /root/reference/pkg/store/client.go:15-130)."""
+from __future__ import annotations
+
+import ctypes
+import threading
+
+import numpy as np
+
+from localai_tpu.native import build_and_load
+
+
+def _lib():
+    lib = build_and_load("store")
+    lib.st_new.restype = ctypes.c_void_p
+    lib.st_new.argtypes = [ctypes.c_int]
+    lib.st_free.argtypes = [ctypes.c_void_p]
+    lib.st_count.restype = ctypes.c_int
+    lib.st_count.argtypes = [ctypes.c_void_p]
+    lib.st_dim.restype = ctypes.c_int
+    lib.st_dim.argtypes = [ctypes.c_void_p]
+    f32p = ctypes.POINTER(ctypes.c_float)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    i32p = ctypes.POINTER(ctypes.c_int)
+    lib.st_set.restype = ctypes.c_int
+    lib.st_set.argtypes = [ctypes.c_void_p, ctypes.c_int, f32p, u8p, i64p]
+    lib.st_delete.restype = ctypes.c_int
+    lib.st_delete.argtypes = [ctypes.c_void_p, ctypes.c_int, f32p]
+    lib.st_lookup.restype = ctypes.c_int
+    lib.st_lookup.argtypes = [ctypes.c_void_p, f32p]
+    lib.st_value_len.restype = ctypes.c_int64
+    lib.st_value_len.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.st_value_copy.argtypes = [ctypes.c_void_p, ctypes.c_int, u8p]
+    lib.st_key_copy.argtypes = [ctypes.c_void_p, ctypes.c_int, f32p]
+    lib.st_find.restype = ctypes.c_int
+    lib.st_find.argtypes = [ctypes.c_void_p, f32p, ctypes.c_int, i32p, f32p]
+    return lib
+
+
+def _f32(a) -> np.ndarray:
+    return np.ascontiguousarray(a, dtype=np.float32)
+
+
+class LocalStore:
+    def __init__(self, dim: int):
+        self.dim = dim
+        self._lib = _lib()
+        self._s = self._lib.st_new(dim)
+        self._lock = threading.Lock()
+
+    def _keys_ptr(self, keys: np.ndarray):
+        keys = _f32(keys).reshape(-1, self.dim)
+        return keys, keys.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+    def set(self, keys, values: list[bytes]):
+        keys, kp = self._keys_ptr(keys)
+        if len(values) != keys.shape[0]:
+            raise ValueError("keys/values length mismatch")
+        blob = b"".join(values)
+        offsets = np.zeros(len(values) + 1, np.int64)
+        np.cumsum([len(v) for v in values], out=offsets[1:])
+        with self._lock:
+            self._lib.st_set(
+                self._s, keys.shape[0], kp,
+                ctypes.cast(ctypes.create_string_buffer(blob, len(blob) or 1),
+                            ctypes.POINTER(ctypes.c_uint8)),
+                offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+
+    def get(self, keys) -> list[bytes | None]:
+        keys, _ = self._keys_ptr(keys)
+        out = []
+        with self._lock:
+            for row_key in keys:
+                kp = row_key.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+                idx = self._lib.st_lookup(self._s, kp)
+                if idx < 0:
+                    out.append(None)
+                    continue
+                n = self._lib.st_value_len(self._s, idx)
+                buf = (ctypes.c_uint8 * max(n, 1))()
+                self._lib.st_value_copy(self._s, idx, buf)
+                out.append(bytes(buf[:n]))
+        return out
+
+    def delete(self, keys) -> int:
+        keys, kp = self._keys_ptr(keys)
+        with self._lock:
+            return self._lib.st_delete(self._s, keys.shape[0], kp)
+
+    def find(self, key, top_k: int):
+        """→ (keys [m, dim] f32, values list[bytes], similarities [m] f32)"""
+        key = _f32(key).reshape(self.dim)
+        rows = (ctypes.c_int * top_k)()
+        sims = (ctypes.c_float * top_k)()
+        with self._lock:
+            m = self._lib.st_find(
+                self._s, key.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                top_k, rows, sims)
+            keys_out = np.zeros((m, self.dim), np.float32)
+            vals = []
+            for i in range(m):
+                self._lib.st_key_copy(
+                    self._s, rows[i],
+                    keys_out[i].ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+                n = self._lib.st_value_len(self._s, rows[i])
+                buf = (ctypes.c_uint8 * max(n, 1))()
+                self._lib.st_value_copy(self._s, rows[i], buf)
+                vals.append(bytes(buf[:n]))
+        return keys_out, vals, np.array(sims[:m], np.float32)
+
+    def __len__(self):
+        with self._lock:
+            return self._lib.st_count(self._s)
+
+    def __del__(self):
+        if getattr(self, "_s", None):
+            self._lib.st_free(self._s)
+            self._s = None

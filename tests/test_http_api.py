@@ -190,6 +190,28 @@ def test_metrics_endpoint(stack):
     assert b"localai_api_calls_total" in r.content
 
 
+def test_stores_http_roundtrip(stack):
+    """/stores/* endpoints spawn an implicit store backend on demand."""
+    base, _ = stack
+    keys = [[1.0, 0.0, 0.0], [0.0, 1.0, 0.0]]
+    r = requests.post(base + "/stores/set", json={
+        "keys": keys, "values": ["alpha", "beta"]}, timeout=120)
+    assert r.status_code == 200, r.text
+    r = requests.post(base + "/stores/find", json={
+        "key": [0.9, 0.1, 0.0], "topk": 2}, timeout=60)
+    body = r.json()
+    assert body["values"][0] == "alpha"
+    assert body["similarities"][0] > body["similarities"][1]
+    r = requests.post(base + "/stores/get", json={"keys": keys[:1]},
+                      timeout=60)
+    assert r.json()["values"] == ["alpha"]
+    requests.post(base + "/stores/delete", json={"keys": keys[:1]},
+                  timeout=60)
+    r = requests.post(base + "/stores/get", json={"keys": keys[:1]},
+                      timeout=60)
+    assert r.json()["values"] == []
+
+
 def test_response_format_json_object(stack):
     """response_format=json_object → grammar-enforced valid JSON output even
     from random weights (chat.go:224-258 semantics, enforced on-device)."""

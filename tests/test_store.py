@@ -1,0 +1,82 @@
+"""Vector store tests: native-lib correctness vs numpy cosine (reference
+tests/integration/stores_test.go:34-60) + gRPC servicer roundtrip."""
+import numpy as np
+import pytest
+
+
+@pytest.fixture()
+def store():
+    from localai_tpu.stores import LocalStore
+
+    return LocalStore(dim=32)
+
+
+def test_set_get_delete(store):
+    rng = np.random.default_rng(0)
+    keys = rng.normal(size=(5, 32)).astype(np.float32)
+    vals = [f"value-{i}".encode() for i in range(5)]
+    store.set(keys, vals)
+    assert len(store) == 5
+    got = store.get(keys[1:3])
+    assert got == [b"value-1", b"value-2"]
+    assert store.get(rng.normal(size=(1, 32)).astype(np.float32)) == [None]
+    assert store.delete(keys[:2]) == 2
+    assert len(store) == 3
+    assert store.get(keys[:1]) == [None]
+
+
+def test_upsert_overwrites(store):
+    k = np.ones((1, 32), np.float32)
+    store.set(k, [b"a"])
+    store.set(k, [b"b"])
+    assert len(store) == 1
+    assert store.get(k) == [b"b"]
+
+
+def test_find_matches_numpy_cosine(store):
+    rng = np.random.default_rng(1)
+    keys = rng.normal(size=(200, 32)).astype(np.float32)
+    vals = [str(i).encode() for i in range(200)]
+    store.set(keys, vals)
+    q = rng.normal(size=(32,)).astype(np.float32)
+
+    norm = keys / np.linalg.norm(keys, axis=1, keepdims=True)
+    ref_sims = norm @ (q / np.linalg.norm(q))
+    ref_order = np.argsort(-ref_sims)[:10]
+
+    found_keys, found_vals, sims = store.find(q, 10)
+    got = [int(v) for v in found_vals]
+    assert got == ref_order.tolist()
+    np.testing.assert_allclose(sims, ref_sims[ref_order], rtol=1e-5, atol=1e-5)
+    # returned keys are the original (unnormalized) vectors
+    np.testing.assert_allclose(found_keys, keys[ref_order], rtol=1e-6, atol=0)
+
+
+def test_find_after_delete(store):
+    keys = np.eye(32, dtype=np.float32)[:4]
+    store.set(keys, [b"0", b"1", b"2", b"3"])
+    store.delete(keys[:1])
+    _, vals, sims = store.find(keys[0], 4)
+    assert b"0" not in vals and len(vals) == 3
+
+
+def test_store_grpc_roundtrip():
+    from localai_tpu.backend.client import BackendClient
+    from localai_tpu.backend.server import serve
+
+    server, servicer, port = serve("127.0.0.1:0", "store")
+    try:
+        c = BackendClient(f"127.0.0.1:{port}")
+        assert c.wait_ready(attempts=20, sleep=0.1)
+        keys = [[1.0, 0.0], [0.0, 1.0], [0.7, 0.7]]
+        c.stores_set(keys, [b"x", b"y", b"diag"])
+        got = c.stores_get([[1.0, 0.0]])
+        assert got.values[0].bytes == b"x"
+        found = c.stores_find([1.0, 0.1], 2)
+        assert found.values[0].bytes == b"x"
+        assert found.similarities[0] > found.similarities[1]
+        c.stores_delete([[1.0, 0.0]])
+        assert len(c.stores_get([[1.0, 0.0]]).values) == 0
+        c.close()
+    finally:
+        server.stop(grace=1)
