@@ -197,6 +197,29 @@ def _mlp(x, lp):
 _shard_act = constrain
 
 
+def _attn_impls():
+    """Select attention kernels at trace time: Pallas (fused, online-softmax)
+    on single-chip TPU; XLA reference under a mesh (GSPMD shards the einsums)
+    or on CPU. LOCALAI_FORCE_PALLAS=1 forces Pallas (interpreter on CPU —
+    used by tests); LOCALAI_NO_PALLAS=1 forces the XLA path."""
+    import os
+
+    from localai_tpu.parallel.mesh import current_mesh
+
+    force = os.environ.get("LOCALAI_FORCE_PALLAS") == "1"
+    block = os.environ.get("LOCALAI_NO_PALLAS") == "1"
+    use = force or (not block and jax.default_backend() == "tpu"
+                    and current_mesh() is None)
+    if use:
+        from localai_tpu.ops.pallas import flash_prefill, ragged_decode
+
+        return (lambda q, k, v, lengths, sliding_window=None:
+                flash_prefill(q, k, v, lengths, sliding_window=sliding_window),
+                lambda q, kc, vc, lengths, sliding_window=None:
+                ragged_decode(q, kc, vc, lengths, sliding_window=sliding_window))
+    return mha_prefill, mha_decode
+
+
 def prefill(params, cfg: LlamaConfig, tokens, lengths, cos, sin,
             k_cache, v_cache, slot_map):
     """Process padded prompt batch, writing K/V into slot rows of the cache.
@@ -206,6 +229,7 @@ def prefill(params, cfg: LlamaConfig, tokens, lengths, cos, sin,
     Returns (last_token_logits [B, V] f32, k_cache, v_cache).
     """
     b, s = tokens.shape
+    attn_prefill, _ = _attn_impls()
     positions = jnp.arange(s)[None, :].repeat(b, 0)
     x = params["embed"].astype(cfg.jdtype)[tokens]
     x = _shard_act(x, P("data", None, None))
@@ -217,7 +241,7 @@ def prefill(params, cfg: LlamaConfig, tokens, lengths, cos, sin,
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
         q = _shard_act(q, P("data", None, "model", None))
-        attn = mha_prefill(q, k, v, lengths, sliding_window=cfg.sliding_window)
+        attn = attn_prefill(q, k, v, lengths, sliding_window=cfg.sliding_window)
         x = x + attn.reshape(b, s, -1) @ lp["wo"]
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         x = x + _mlp(h, lp)
@@ -250,6 +274,7 @@ def decode_step(params, cfg: LlamaConfig, tokens, lengths, cos, sin,
     Returns (logits [B, V] f32, k_cache, v_cache).
     """
     b = tokens.shape[0]
+    _, attn_decode = _attn_impls()
     positions = lengths[:, None]  # [B,1]
     x = params["embed"].astype(cfg.jdtype)[tokens][:, None, :]  # [B,1,H]
 
@@ -261,8 +286,8 @@ def decode_step(params, cfg: LlamaConfig, tokens, lengths, cos, sin,
         k = apply_rope(k, cos, sin, positions)
         kc = kc.at[jnp.arange(b)[:, None], positions].set(k)
         vc = vc.at[jnp.arange(b)[:, None], positions].set(v)
-        attn = mha_decode(q, kc, vc, lengths + 1,
-                          sliding_window=cfg.sliding_window)
+        attn = attn_decode(q, kc, vc, lengths + 1,
+                           sliding_window=cfg.sliding_window)
         x = x + attn.reshape(b, 1, -1) @ lp["wo"]
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         x = x + _mlp(h, lp)
@@ -287,6 +312,7 @@ def hidden_states(params, cfg: LlamaConfig, tokens, lengths=None):
     positions = jnp.arange(s)[None, :].repeat(b, 0)
     if lengths is None:
         lengths = jnp.full((b,), s, jnp.int32)
+    attn_prefill, _ = _attn_impls()
     x = params["embed"].astype(cfg.jdtype)[tokens]
     x = _shard_act(x, P("data", None, None))
 
@@ -296,7 +322,7 @@ def hidden_states(params, cfg: LlamaConfig, tokens, lengths=None):
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
         q = _shard_act(q, P("data", None, "model", None))
-        attn = mha_prefill(q, k, v, lengths, sliding_window=cfg.sliding_window)
+        attn = attn_prefill(q, k, v, lengths, sliding_window=cfg.sliding_window)
         x = x + attn.reshape(b, s, -1) @ lp["wo"]
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         x = x + _mlp(h, lp)

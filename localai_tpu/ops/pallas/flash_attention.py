@@ -1,0 +1,193 @@
+"""Pallas TPU attention kernels — the native compute tier.
+
+Reference parity note: llama.cpp's flash-attention toggle
+(/root/reference/backend/backend.proto:247) enables fused CUDA attention; here
+the fused kernels are Mosaic/Pallas, written block-wise for the MXU with
+online softmax so the [S, S] score matrix never hits HBM (memory O(block²)
+instead of O(S²)).
+
+Two kernels:
+- flash_prefill: causal GQA attention over padded prompt batches
+  [B, S, H, D]; per-row validity from `lengths`; optional sliding window.
+- ragged_decode: one-token-per-slot decode attention against the slot KV
+  cache [B, T, KVH, D]; each (slot, head) program scans only
+  ceil(length/BLOCK) KV blocks — the "ragged" part that makes long-context
+  decode O(valid tokens), not O(max context).
+
+On CPU (tests) both run in interpreter mode; the math is identical.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# large-but-finite so exp(NEG_INF - NEG_INF) stays 0/1 instead of NaN when a
+# row's first blocks are fully masked (sliding window, ragged tails)
+NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def pallas_available() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# --------------------------------------------------------------- prefill
+
+def _prefill_kernel(lengths_ref, q_ref, k_ref, v_ref, o_ref, *,
+                    block_q: int, block_k: int, scale: float,
+                    sliding_window: int | None):
+    qb = pl.program_id(2)
+    length = lengths_ref[0]
+    q = q_ref[0, :, 0, :].astype(jnp.float32) * scale          # [BQ, D]
+    S = k_ref.shape[1]
+    num_kb = pl.cdiv(S, block_k)
+
+    q_pos = qb * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), 0, :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), 0, :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)  # [BQ, BK]
+        k_pos = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = (k_pos <= q_pos) & (k_pos < length)
+        if sliding_window is not None:
+            mask &= k_pos > q_pos - sliding_window
+        s = jnp.where(mask, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[:, None] + jnp.dot(
+            p, v_blk, preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    # causal: only KV blocks up to (and including) this query block
+    last_kb = jnp.minimum(
+        (qb + 1) * block_q + block_k - 1, S + block_k - 1) // block_k
+    last_kb = jnp.minimum(last_kb, num_kb)
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, last_kb, body, (m0, l0, acc0))
+
+    out = acc / jnp.maximum(l, 1e-30)[:, None]
+    o_ref[0, :, 0, :] = out.astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("sliding_window", "block_q",
+                                             "block_k"))
+def flash_prefill(q, k, v, lengths, sliding_window=None,
+                  block_q: int = 128, block_k: int = 128):
+    """Causal GQA flash attention. q: [B, S, H, D]; k/v: [B, S, KVH, D];
+    lengths: [B]. Returns [B, S, H, D] in q.dtype."""
+    B, S, H, D = q.shape
+    KVH = k.shape[2]
+    group = H // KVH
+    block_q = min(block_q, S)
+    block_k = min(block_k, S)
+    scale = D ** -0.5
+
+    grid = (B, H, pl.cdiv(S, block_q))
+    kernel = functools.partial(
+        _prefill_kernel, block_q=block_q, block_k=block_k, scale=scale,
+        sliding_window=sliding_window)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1,), lambda b, h, qb: (b,),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, block_q, 1, D),
+                         lambda b, h, qb: (b, qb, h, 0)),
+            pl.BlockSpec((1, S, 1, D),
+                         lambda b, h, qb: (b, 0, h // group, 0)),
+            pl.BlockSpec((1, S, 1, D),
+                         lambda b, h, qb: (b, 0, h // group, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, 1, D),
+                               lambda b, h, qb: (b, qb, h, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=_interpret(),
+    )(lengths.astype(jnp.int32), q, k, v)
+
+
+# --------------------------------------------------------------- decode
+
+def _decode_kernel(lengths_ref, q_ref, k_ref, v_ref, o_ref, *,
+                   block_k: int, scale: float, sliding_window: int | None):
+    length = lengths_ref[0]
+    q = q_ref[0, 0, 0, :, :].astype(jnp.float32) * scale        # [G, D]
+    T = k_ref.shape[1]
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), 0, :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), 0, :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)  # [G, BK]
+        k_pos = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (q.shape[0], block_k), 1)
+        mask = k_pos < length
+        if sliding_window is not None:
+            mask &= k_pos > length - 1 - sliding_window
+        s = jnp.where(mask, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[:, None] + jnp.dot(
+            p, v_blk, preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    # ragged: scan only the blocks holding valid cache entries
+    num_kb = jnp.minimum(pl.cdiv(length, block_k), pl.cdiv(T, block_k))
+    G = q.shape[0]
+    m0 = jnp.full((G,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((G,), jnp.float32)
+    acc0 = jnp.zeros((G, q.shape[-1]), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
+    out = acc / jnp.maximum(l, 1e-30)[:, None]
+    o_ref[0, 0, 0, :, :] = out.astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("sliding_window", "block_k"))
+def ragged_decode(q, k_cache, v_cache, lengths, sliding_window=None,
+                  block_k: int = 256):
+    """Decode-step GQA attention. q: [B, 1, H, D]; caches [B, T, KVH, D];
+    lengths: [B] valid entries incl. the newly-written token.
+    Returns [B, 1, H, D]."""
+    B, _, H, D = q.shape
+    T, KVH = k_cache.shape[1], k_cache.shape[2]
+    group = H // KVH
+    block_k = min(block_k, T)
+    scale = D ** -0.5
+
+    # one program per (slot, kv head): its q block is the GQA group
+    qg = q.reshape(B, 1, KVH, group, D)
+    kernel = functools.partial(_decode_kernel, block_k=block_k, scale=scale,
+                               sliding_window=sliding_window)
+    out = pl.pallas_call(
+        kernel,
+        grid=(B, KVH),
+        in_specs=[
+            pl.BlockSpec((1,), lambda b, h: (b,),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, 1, group, D), lambda b, h: (b, 0, h, 0, 0)),
+            pl.BlockSpec((1, T, 1, D), lambda b, h: (b, 0, h, 0)),
+            pl.BlockSpec((1, T, 1, D), lambda b, h: (b, 0, h, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, 1, group, D),
+                               lambda b, h: (b, 0, h, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct(qg.shape, q.dtype),
+        interpret=_interpret(),
+    )(lengths.astype(jnp.int32), qg, k_cache, v_cache)
+    return out.reshape(B, 1, H, D)
