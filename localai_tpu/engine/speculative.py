@@ -1,0 +1,186 @@
+"""Speculative decoding: draft model proposes, target verifies in one pass.
+
+Reference surface: llama.cpp draft-model speculation
+(/root/reference/backend/backend.proto:218 DraftModel, :150 NDraft). TPU-first
+shape: the draft runs gamma cheap decode steps; the target scores all gamma+1
+positions in ONE `extend` forward (a [gamma+1]-token matmul batch that keeps
+the MXU busy), then canonical rejection sampling (Leviathan et al. 2023)
+accepts a prefix and resamples once — output distribution provably equals the
+target model's.
+
+Temperature sampling uses the full softmax for both models (rejection
+sampling needs a common support; truncation knobs apply to the non-speculative
+path). temperature=0 degenerates to exact greedy-match acceptance.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from localai_tpu.models.llama import (
+    LlamaConfig, decode_step, extend, init_kv_cache, prefill,
+)
+from localai_tpu.ops.rope import rope_table
+
+
+@dataclasses.dataclass
+class SpecStats:
+    proposed: int = 0
+    accepted: int = 0
+
+    @property
+    def acceptance_rate(self) -> float:
+        return self.accepted / self.proposed if self.proposed else 0.0
+
+
+class SpeculativeDecoder:
+    """Single-stream speculative generation over (target, draft) models."""
+
+    def __init__(self, cfg_t: LlamaConfig, params_t, cfg_d: LlamaConfig,
+                 params_d, *, gamma: int = 4, max_context: int = 1024):
+        if cfg_t.vocab_size != cfg_d.vocab_size:
+            raise ValueError("draft/target vocabularies differ")
+        self.cfg_t, self.params_t = cfg_t, params_t
+        self.cfg_d, self.params_d = cfg_d, params_d
+        self.gamma = gamma
+        self.T = min(max_context, cfg_t.max_position, cfg_d.max_position)
+        self.stats = SpecStats()
+
+        self._cos_t, self._sin_t = rope_table(cfg_t.rope, self.T)
+        self._cos_d, self._sin_d = rope_table(cfg_d.rope, self.T)
+        self._prefill_t = jax.jit(partial(prefill, cfg=cfg_t))
+        self._prefill_d = jax.jit(partial(prefill, cfg=cfg_d))
+        self._decode_d = jax.jit(partial(decode_step, cfg=cfg_d))
+        self._extend_t = jax.jit(partial(extend, cfg=cfg_t))
+        self._extend_d = jax.jit(partial(extend, cfg=cfg_d))
+
+    def _softmax(self, logits, temperature):
+        if temperature <= 0:
+            return None  # greedy
+        return jax.nn.softmax(logits / temperature, axis=-1)
+
+    def generate(self, prompt_ids: list[int], max_tokens: int,
+                 temperature: float = 0.0, seed: int = 0,
+                 eos_ids: set[int] | None = None) -> list[int]:
+        eos_ids = eos_ids or set()
+        rng = np.random.default_rng(seed)
+        n = len(prompt_ids)
+        if n + max_tokens + self.gamma + 1 > self.T:
+            raise ValueError("prompt + max_tokens exceeds speculative context")
+
+        kc_t, vc_t = init_kv_cache(self.cfg_t, 1, self.T)
+        kc_d, vc_d = init_kv_cache(self.cfg_d, 1, self.T)
+        ids = np.zeros((1, self.T), np.int32)
+        ids[0, :n] = prompt_ids
+        lengths = jnp.array([n], jnp.int32)
+        slot = jnp.array([0], jnp.int32)
+
+        logits_t, kc_t, vc_t = self._prefill_t(
+            self.params_t, tokens=jnp.asarray(ids[:, :n]), lengths=lengths,
+            cos=self._cos_t, sin=self._sin_t, k_cache=kc_t, v_cache=vc_t,
+            slot_map=slot)
+        _, kc_d, vc_d = self._prefill_d(
+            self.params_d, tokens=jnp.asarray(ids[:, :n]), lengths=lengths,
+            cos=self._cos_d, sin=self._sin_d, k_cache=kc_d, v_cache=vc_d,
+            slot_map=slot)
+
+        out: list[int] = []
+        all_ids = list(prompt_ids)       # every committed token, by position
+        # logits (target) for the next token after the committed sequence
+        last_logits_t = logits_t[0]
+        pos = n                          # committed length
+        draft_done = n                   # committed positions in draft cache
+
+        def sample_from(logits):
+            if temperature <= 0:
+                return int(jnp.argmax(logits))
+            p = np.asarray(jax.nn.softmax(logits / temperature))
+            return int(rng.choice(len(p), p=p / p.sum()))
+
+        while len(out) < max_tokens:
+            gamma = min(self.gamma, max_tokens - len(out))
+            prev = sample_from(last_logits_t)
+            out.append(prev)
+            all_ids.append(prev)
+            if prev in eos_ids or len(out) >= max_tokens:
+                break
+
+            # --- draft: catch up on committed tokens it hasn't seen (incl.
+            # prev), then propose gamma tokens sequentially
+            catch_up = all_ids[draft_done: pos + 1]   # positions draft_done..pos
+            dl, kc_d, vc_d = self._extend_d(
+                self.params_d,
+                tokens=jnp.asarray(catch_up, jnp.int32)[None, :],
+                start=jnp.array([draft_done], jnp.int32),
+                cos=self._cos_d, sin=self._sin_d, k_cache=kc_d, v_cache=vc_d)
+            draft_done = pos + 1
+            dlogits_all = [dl[0, -1]]
+            draft_tokens = [sample_from(dl[0, -1])]
+            for g in range(1, gamma):
+                dstep, kc_d, vc_d = self._decode_d(
+                    self.params_d,
+                    tokens=jnp.array([draft_tokens[-1]], jnp.int32),
+                    lengths=jnp.array([pos + g], jnp.int32),
+                    cos=self._cos_d, sin=self._sin_d, k_cache=kc_d,
+                    v_cache=vc_d)
+                dlogits_all.append(dstep[0])
+                draft_tokens.append(sample_from(dstep[0]))
+
+            # --- target scores the whole window in one extend pass
+            window = [prev] + draft_tokens
+            tl, kc_t, vc_t = self._extend_t(
+                self.params_t, tokens=jnp.asarray(window, jnp.int32)[None, :],
+                start=jnp.array([pos], jnp.int32),
+                cos=self._cos_t, sin=self._sin_t, k_cache=kc_t, v_cache=vc_t)
+            tlogits = tl[0]  # row g scores the token after window[g]
+
+            # --- accept / reject (Leviathan-style)
+            n_accept = 0
+            resampled = None
+            for g, d_tok in enumerate(draft_tokens):
+                if len(out) >= max_tokens or out[-1] in eos_ids:
+                    break
+                self.stats.proposed += 1
+                if temperature <= 0:
+                    t_tok = int(jnp.argmax(tlogits[g]))
+                    if t_tok == d_tok:
+                        out.append(d_tok)
+                        all_ids.append(d_tok)
+                        n_accept += 1
+                        continue
+                    resampled = t_tok
+                    break
+                pt = np.asarray(jax.nn.softmax(tlogits[g] / temperature))
+                pd = np.asarray(jax.nn.softmax(dlogits_all[g] / temperature))
+                if rng.random() < min(1.0, pt[d_tok] / max(pd[d_tok], 1e-20)):
+                    out.append(d_tok)
+                    all_ids.append(d_tok)
+                    n_accept += 1
+                    continue
+                resid = np.maximum(pt - pd, 0.0)
+                s = resid.sum()
+                resampled = (int(rng.choice(len(resid), p=resid / s))
+                             if s > 0 else int(np.argmax(pt)))
+                break
+            self.stats.accepted += n_accept
+
+            old_pos = pos
+            pos += 1 + n_accept           # prev + accepted draft tokens
+            # draft cache now holds prev (old_pos) + d_1..d_{gamma-1}; of
+            # those, only positions < pos are committed — the rest are stale
+            # and get overwritten by the next catch-up pass
+            draft_done = min(old_pos + gamma, pos)
+            if resampled is not None and len(out) < max_tokens:
+                # commit `resampled` as next iteration's forced `prev`
+                one_hot = jnp.full((self.cfg_t.vocab_size,), -1e9, jnp.float32)
+                last_logits_t = one_hot.at[resampled].set(0.0)
+            else:
+                last_logits_t = tlogits[n_accept]
+            if out[-1] in eos_ids:
+                break
+
+        return out[:max_tokens]

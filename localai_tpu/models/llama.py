@@ -333,6 +333,45 @@ def hidden_states(params, cfg: LlamaConfig, tokens, lengths=None):
     return rms_norm(x, params["final_norm"], cfg.rms_eps)
 
 
+def extend(params, cfg: LlamaConfig, tokens, start, cos, sin,
+           k_cache, v_cache):
+    """Forward a window of S tokens per slot starting at cache offset
+    `start` [B] — the speculative-decoding verification pass (reference knob:
+    DraftModel/NDraft, /root/reference/backend/backend.proto:218,150). Writes
+    window K/V into the cache and returns logits for EVERY window position
+    [B, S, V] plus the updated caches."""
+    from localai_tpu.ops.attention import mha_extend
+
+    b, s = tokens.shape
+    positions = start[:, None] + jnp.arange(s)[None, :]
+    x = params["embed"].astype(cfg.jdtype)[tokens]
+
+    def layer(x, xs):
+        lp, kc, vc = xs
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+        q, k, v = _qkv(h, lp, cfg)
+        q = apply_rope(q, cos, sin, positions)
+        k = apply_rope(k, cos, sin, positions)
+        kc = kc.at[jnp.arange(b)[:, None], positions].set(k)
+        vc = vc.at[jnp.arange(b)[:, None], positions].set(v)
+        attn = mha_extend(q, kc, vc, positions,
+                          sliding_window=cfg.sliding_window)
+        x = x + attn.reshape(b, s, -1) @ lp["wo"]
+        h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+        x = x + _mlp(h, lp)
+        return x, (kc, vc)
+
+    x, (k_cache, v_cache) = jax.lax.scan(
+        layer, x, (params["layers"], k_cache, v_cache)
+    )
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    head = params.get("lm_head", None)
+    if head is None:
+        head = params["embed"].T
+    logits = x.astype(jnp.float32) @ head.astype(jnp.float32)
+    return logits, k_cache, v_cache
+
+
 def forward_train(params, cfg: LlamaConfig, tokens):
     """Full-sequence causal forward → logits [B, S, V] (training / eval path)."""
     x = hidden_states(params, cfg, tokens)

@@ -59,6 +59,35 @@ def mha_prefill(q, k, v, lengths, *, scale=None, softcap=None, sliding_window=No
     return out.reshape(b, s, h, d)
 
 
+def mha_extend(q, k_cache, v_cache, q_positions, *, scale=None,
+               sliding_window=None):
+    """Window attention against the cache: scores S new tokens whose K/V are
+    already written at `q_positions` (speculative-verification forward).
+
+    q: [B, S, H, D]; caches: [B, T, KVH, D]; q_positions: [B, S] global
+    positions of the window tokens. Each query attends to every cache entry
+    at position <= its own. Returns [B, S, H, D].
+    """
+    b, s, h, d = q.shape
+    t = k_cache.shape[1]
+    kvh = k_cache.shape[2]
+    scale = scale if scale is not None else d ** -0.5
+
+    qg = _group_query_heads(q, kvh)                             # [B,S,KVH,G,D]
+    logits = jnp.einsum("bskgd,btkd->bkgst", qg, k_cache).astype(jnp.float32) * scale
+
+    pos = jnp.arange(t)
+    mask = pos[None, None, :] <= q_positions[:, :, None]        # [B,S,T]
+    if sliding_window is not None and sliding_window > 0:
+        mask = mask & (pos[None, None, :]
+                       > q_positions[:, :, None] - sliding_window)
+    logits = jnp.where(mask[:, None, None, :, :], logits, NEG_INF)
+
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bkgst,btkd->bskgd", probs, v_cache)
+    return out.reshape(b, s, h, d)
+
+
 def mha_decode(q, k_cache, v_cache, lengths, *, scale=None, softcap=None,
                sliding_window=None):
     """Single-token decode attention against a slot-contiguous KV cache.
