@@ -1,0 +1,2 @@
+from localai_tpu.audio.pcm import read_wav, write_wav, f32_to_i16, i16_to_f32  # noqa: F401
+from localai_tpu.audio.vad import detect_segments  # noqa: F401

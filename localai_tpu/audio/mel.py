@@ -1,0 +1,73 @@
+"""Whisper log-mel spectrogram, numerically matching HF's
+WhisperFeatureExtractor (parity-tested in tests/test_whisper.py): hann 400,
+hop 160, slaney-scale/slaney-norm 80/128-bin mel filterbank, log10 with 8 dB
+dynamic-range floor, (x+4)/4 normalization. Pure numpy (host-side feature
+extraction feeding the TPU encoder)."""
+from __future__ import annotations
+
+import numpy as np
+
+SAMPLE_RATE = 16000
+N_FFT = 400
+HOP = 160
+CHUNK_SECONDS = 30
+N_SAMPLES = SAMPLE_RATE * CHUNK_SECONDS
+
+
+def _hertz_to_mel(f):
+    # slaney scale: linear below 1 kHz, log above
+    f = np.asarray(f, np.float64)
+    mel = 3.0 * f / 200.0
+    log_region = f >= 1000.0
+    mel = np.where(log_region,
+                   15.0 + np.log(np.maximum(f, 1e-10) / 1000.0) * (27.0 / np.log(6.4)),
+                   mel)
+    return mel
+
+
+def _mel_to_hertz(m):
+    m = np.asarray(m, np.float64)
+    f = 200.0 * m / 3.0
+    log_region = m >= 15.0
+    f = np.where(log_region, 1000.0 * np.exp(np.log(6.4) / 27.0 * (m - 15.0)), f)
+    return f
+
+
+def mel_filters(n_mels: int = 80, n_fft: int = N_FFT,
+                rate: int = SAMPLE_RATE) -> np.ndarray:
+    """[n_freq, n_mels] slaney-normalized triangular filterbank."""
+    n_freq = n_fft // 2 + 1
+    fft_freqs = np.linspace(0, rate / 2, n_freq)
+    mel_pts = np.linspace(_hertz_to_mel(0.0), _hertz_to_mel(8000.0), n_mels + 2)
+    hz_pts = _mel_to_hertz(mel_pts)
+
+    fdiff = np.diff(hz_pts)
+    slopes = hz_pts[None, :] - fft_freqs[:, None]          # [n_freq, n_mels+2]
+    down = -slopes[:, :-2] / fdiff[:-1]
+    up = slopes[:, 2:] / fdiff[1:]
+    fb = np.maximum(0.0, np.minimum(down, up))
+    enorm = 2.0 / (hz_pts[2:] - hz_pts[:-2])               # slaney norm
+    return (fb * enorm[None, :]).astype(np.float32)
+
+
+def log_mel_spectrogram(audio: np.ndarray, n_mels: int = 80,
+                        pad_to_chunk: bool = True) -> np.ndarray:
+    """mono f32 audio @16 kHz → [n_mels, frames] f32 (HF-compatible)."""
+    audio = np.asarray(audio, np.float32)
+    if pad_to_chunk:
+        audio = audio[:N_SAMPLES]
+        audio = np.pad(audio, (0, N_SAMPLES - len(audio)))
+    # center-padded reflective framing (np.fft STFT)
+    pad = N_FFT // 2
+    x = np.pad(audio.astype(np.float64), (pad, pad), mode="reflect")
+    window = np.hanning(N_FFT + 1)[:-1]
+    n_frames = 1 + (len(x) - N_FFT) // HOP
+    idx = np.arange(N_FFT)[None, :] + HOP * np.arange(n_frames)[:, None]
+    frames = x[idx] * window[None, :]
+    spec = np.abs(np.fft.rfft(frames, axis=1)) ** 2         # [frames, n_freq]
+    spec = spec[:-1]                                        # drop last (HF)
+    mel = spec @ mel_filters(n_mels)                        # [frames, n_mels]
+    log_spec = np.log10(np.maximum(mel, 1e-10))
+    log_spec = np.maximum(log_spec, log_spec.max() - 8.0)
+    log_spec = (log_spec + 4.0) / 4.0
+    return log_spec.T.astype(np.float32)                    # [n_mels, frames]

@@ -1,0 +1,52 @@
+"""PCM / WAV utilities (reference: /root/reference/pkg/sound — float/int16
+conversion — and the ffmpeg shell-outs in pkg/utils). Stdlib `wave` + numpy;
+resampling via scipy polyphase."""
+from __future__ import annotations
+
+import wave
+
+import numpy as np
+
+
+def i16_to_f32(x: np.ndarray) -> np.ndarray:
+    return (x.astype(np.float32) / 32768.0).clip(-1.0, 1.0)
+
+
+def f32_to_i16(x: np.ndarray) -> np.ndarray:
+    return (np.asarray(x, np.float32).clip(-1.0, 1.0) * 32767.0).astype(np.int16)
+
+
+def read_wav(path: str, target_rate: int | None = None) -> tuple[np.ndarray, int]:
+    """→ (mono float32 [-1, 1], sample_rate); resamples when target_rate set."""
+    with wave.open(path, "rb") as w:
+        rate = w.getframerate()
+        n = w.getnframes()
+        width = w.getsampwidth()
+        channels = w.getnchannels()
+        raw = w.readframes(n)
+    if width == 2:
+        audio = i16_to_f32(np.frombuffer(raw, np.int16))
+    elif width == 4:
+        audio = np.frombuffer(raw, np.int32).astype(np.float32) / 2**31
+    elif width == 1:
+        audio = (np.frombuffer(raw, np.uint8).astype(np.float32) - 128.0) / 128.0
+    else:
+        raise ValueError(f"unsupported sample width {width}")
+    if channels > 1:
+        audio = audio.reshape(-1, channels).mean(axis=1)
+    if target_rate and target_rate != rate:
+        from scipy.signal import resample_poly
+        from math import gcd
+
+        g = gcd(target_rate, rate)
+        audio = resample_poly(audio, target_rate // g, rate // g).astype(np.float32)
+        rate = target_rate
+    return audio.astype(np.float32), rate
+
+
+def write_wav(path: str, audio: np.ndarray, rate: int = 16000):
+    with wave.open(path, "wb") as w:
+        w.setnchannels(1)
+        w.setsampwidth(2)
+        w.setframerate(rate)
+        w.writeframes(f32_to_i16(audio).tobytes())

@@ -1,0 +1,85 @@
+"""Parametric DSP speech synthesizer — the TTS capability's built-in voice.
+
+Reference ships neural TTS backends (piper ONNX voices, bark.cpp —
+/root/reference/backend/go/piper, backend/go/bark-cpp); neither runtime exists
+in this image, so the TTS contract (RPC + endpoints + WAV output) is served by
+a dependency-free formant synthesizer: each phoneme-ish character class maps
+to a short formant-filtered excitation. A neural JAX voice can drop in behind
+`synthesize()` without touching the contract.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+RATE = 16000
+
+# (f1, f2) rough vowel formants; consonants → noise bursts
+_VOWELS = {
+    "a": (730, 1090), "e": (530, 1840), "i": (270, 2290),
+    "o": (570, 840), "u": (300, 870), "y": (270, 2100),
+}
+_PAUSE = set(" \t\n.,;:!?-")
+
+
+def _formant_tone(f1, f2, dur, pitch=120.0):
+    t = np.arange(int(dur * RATE)) / RATE
+    # glottal-ish source: pitch + harmonics, shaped by two formant resonances
+    src = (np.sin(2 * np.pi * pitch * t)
+           + 0.5 * np.sin(2 * np.pi * 2 * pitch * t)
+           + 0.25 * np.sin(2 * np.pi * 3 * pitch * t))
+    form = (0.6 * np.sin(2 * np.pi * f1 * t)
+            + 0.4 * np.sin(2 * np.pi * f2 * t))
+    sig = src * (0.5 + 0.5 * form)
+    env = np.minimum(1.0, np.minimum(t / 0.02, (dur - t) / 0.04).clip(0))
+    return (sig * env).astype(np.float32)
+
+
+def _noise_burst(dur, color=0.5, seed=0):
+    rng = np.random.default_rng(seed)
+    n = int(dur * RATE)
+    x = rng.normal(size=n).astype(np.float32)
+    # crude one-pole lowpass for "color"
+    y = np.empty_like(x)
+    acc = 0.0
+    for i in range(n):
+        acc = color * acc + (1 - color) * x[i]
+        y[i] = acc
+    env = np.minimum(1.0, np.arange(n) / (0.004 * RATE))
+    return (0.6 * y * env * env[::-1]).astype(np.float32)
+
+
+def synthesize(text: str, voice: str = "default", language: str = "en"
+               ) -> np.ndarray:
+    """text → mono f32 waveform @16 kHz."""
+    pitch = {"default": 120.0, "low": 90.0, "high": 170.0}.get(voice, 120.0)
+    parts = [np.zeros(int(0.05 * RATE), np.float32)]
+    for i, ch in enumerate(text.lower()):
+        if ch in _PAUSE:
+            parts.append(np.zeros(int(0.12 * RATE), np.float32))
+        elif ch in _VOWELS:
+            f1, f2 = _VOWELS[ch]
+            parts.append(_formant_tone(f1, f2, 0.11, pitch))
+        elif ch.isalpha():
+            parts.append(_noise_burst(0.06, color=0.3 + 0.02 * (ord(ch) % 20),
+                                      seed=ord(ch)))
+        elif ch.isdigit():
+            parts.append(_formant_tone(400 + 40 * int(ch), 1200, 0.1, pitch))
+    audio = np.concatenate(parts) if parts else np.zeros(RATE, np.float32)
+    peak = np.abs(audio).max()
+    return (0.8 * audio / peak).astype(np.float32) if peak > 0 else audio
+
+
+def generate_sound(text: str, duration: float = 2.0, seed: int = 0
+                   ) -> np.ndarray:
+    """SoundGeneration role (reference musicgen path): deterministic
+    text-seeded ambient tone mixture."""
+    rng = np.random.default_rng(abs(hash(text)) % (2 ** 31) + seed)
+    t = np.arange(int(duration * RATE)) / RATE
+    audio = np.zeros_like(t, dtype=np.float32)
+    for _ in range(5):
+        f = float(rng.uniform(80, 1200))
+        a = float(rng.uniform(0.05, 0.25))
+        ph = float(rng.uniform(0, 2 * np.pi))
+        audio += (a * np.sin(2 * np.pi * f * t + ph)).astype(np.float32)
+    env = np.minimum(1.0, np.minimum(t / 0.1, (duration - t) / 0.2).clip(0))
+    return (audio * env / max(np.abs(audio).max(), 1e-6) * 0.7).astype(np.float32)

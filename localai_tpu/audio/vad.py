@@ -1,0 +1,68 @@
+"""Voice-activity detection: adaptive-threshold energy VAD.
+
+Reference uses silero-vad via ONNX runtime
+(/root/reference/backend/go/silero-vad/vad.go) — not available in this image,
+so the VAD capability ships as a dependency-free spectral-energy detector with
+the same RPC/HTTP contract (segments of {start, end} seconds). Model-based VAD
+can drop in behind the same interface.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class VADConfig:
+    rate: int = 16000
+    frame_ms: float = 30.0
+    # threshold = noise_floor * ratio (adaptive), floored at min_energy
+    energy_ratio: float = 4.0
+    min_energy: float = 1e-4
+    min_speech_ms: float = 90.0
+    hangover_ms: float = 150.0        # keep speech alive over short dips
+
+
+def detect_segments(audio: np.ndarray, cfg: VADConfig | None = None
+                    ) -> list[tuple[float, float]]:
+    """mono f32 → [(start_s, end_s), ...] speech segments."""
+    cfg = cfg or VADConfig()
+    frame = max(1, int(cfg.rate * cfg.frame_ms / 1000.0))
+    n = len(audio) // frame
+    if n == 0:
+        return []
+    x = np.asarray(audio[: n * frame], np.float32).reshape(n, frame)
+    energy = np.sqrt((x ** 2).mean(axis=1))                 # per-frame RMS
+
+    # adaptive noise floor: median of the quietest half
+    quiet = np.sort(energy)[: max(1, n // 2)]
+    floor = float(np.median(quiet))
+    thresh = max(floor * cfg.energy_ratio, cfg.min_energy)
+    active = energy > thresh
+
+    hang = max(1, int(cfg.hangover_ms / cfg.frame_ms))
+    min_frames = max(1, int(cfg.min_speech_ms / cfg.frame_ms))
+
+    segments = []
+    start = None
+    gap = 0
+    for i, a in enumerate(active):
+        if a:
+            if start is None:
+                start = i
+            gap = 0
+        elif start is not None:
+            gap += 1
+            if gap > hang:
+                end = i - gap + 1
+                if end - start >= min_frames:
+                    segments.append((start, end))
+                start, gap = None, 0
+    if start is not None:
+        end = n
+        if end - start >= min_frames:
+            segments.append((start, end))
+
+    sec = cfg.frame_ms / 1000.0
+    return [(round(s * sec, 3), round(e * sec, 3)) for s, e in segments]

@@ -90,6 +90,10 @@ class BackendClient:
     def tts(self, timeout: float = 600.0, **kw) -> "pb.Result":
         return self._calls["TTS"](pb.TTSRequest(**kw), timeout=timeout)
 
+    def sound_generation(self, timeout: float = 600.0, **kw) -> "pb.Result":
+        return self._calls["SoundGeneration"](
+            pb.SoundGenerationRequest(**kw), timeout=timeout)
+
     def transcribe(self, timeout: float = 600.0, **kw) -> "pb.TranscriptResult":
         return self._calls["AudioTranscription"](pb.TranscriptRequest(**kw),
                                                  timeout=timeout)

@@ -217,6 +217,33 @@ class LLMServicer(BackendServicer):
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
         return pb.EmbeddingResult(embeddings=vec.tolist())
 
+    def Rerank(self, request, context):
+        """Embedding-similarity rerank (reference Rerank RPC,
+        grpc-server.cpp:1466 / rerankers backend). Scores are cosine
+        similarity between pooled query/document embeddings."""
+        if self.embedder is None:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                          "model loaded without embeddings=true")
+        if not request.query or not request.documents:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          "query and documents required")
+        ids = [self.tok.encode(request.query)] + [
+            self.tok.encode(d) for d in request.documents
+        ]
+        try:
+            vecs = self.embedder.embed(ids)
+        except ValueError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        sims = vecs[1:] @ vecs[0]
+        order = sims.argsort()[::-1]
+        top_n = request.top_n or len(order)
+        resp = pb.RerankResult()
+        for i in order[:top_n]:
+            resp.results.append(pb.RerankedDocument(
+                index=int(i), text=request.documents[int(i)],
+                relevance_score=float(sims[int(i)])))
+        return resp
+
     def Status(self, request, context):
         rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
         return pb.StatusResponse(

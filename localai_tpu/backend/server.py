@@ -36,6 +36,20 @@ def _make_llm():
     return LLMServicer()
 
 
+@_role("whisper")
+def _make_whisper():
+    from localai_tpu.backend.whisper import WhisperServicer
+
+    return WhisperServicer()
+
+
+@_role("tts")
+def _make_tts():
+    from localai_tpu.backend.whisper import TTSServicer
+
+    return TTSServicer()
+
+
 @_role("store")
 def _make_store():
     from localai_tpu.backend.store import StoreServicer

@@ -1,0 +1,112 @@
+"""Whisper transcription backend servicer (reference:
+/root/reference/backend/go/whisper/gowhisper.go — AudioTranscription with
+segments — plus the silero VAD backend's VAD RPC, vad.go:1-58)."""
+from __future__ import annotations
+
+import threading
+
+import grpc
+import numpy as np
+
+from localai_tpu.backend import pb
+from localai_tpu.backend.base import BackendServicer
+
+
+class WhisperServicer(BackendServicer):
+    def __init__(self):
+        self.model = None
+        self._lock = threading.Lock()
+
+    def LoadModel(self, request, context):
+        import os
+
+        with self._lock:
+            if self.model is not None:
+                return pb.Result(success=True, message="already loaded")
+            try:
+                from localai_tpu.models.whisper import WhisperModel
+
+                model_dir = request.model
+                if request.model_path and not os.path.isdir(model_dir):
+                    model_dir = os.path.join(request.model_path, request.model)
+                self.model = WhisperModel(model_dir, dtype=request.dtype or None)
+                return pb.Result(success=True, message="ok")
+            except Exception as e:
+                return pb.Result(success=False,
+                                 message=f"{type(e).__name__}: {e}")
+
+    def AudioTranscription(self, request, context):
+        if self.model is None:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, "no model")
+        from localai_tpu.audio.pcm import read_wav
+        from localai_tpu.audio.vad import detect_segments
+
+        try:
+            audio, _ = read_wav(request.dst, target_rate=16000)
+        except Exception as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          f"cannot read audio: {e}")
+        # VAD-split → one whisper pass per speech segment (segments shape of
+        # the reference's whisper_full segments)
+        spans = detect_segments(audio) or (
+            [(0.0, len(audio) / 16000.0)] if len(audio) else [])
+        resp = pb.TranscriptResult()
+        texts = []
+        for i, (s, e) in enumerate(spans):
+            chunk = audio[int(s * 16000): int(e * 16000)]
+            toks = self.model.transcribe_tokens(chunk)
+            text = (self.model.tokenizer.decode(toks, skip_special_tokens=True)
+                    if self.model.tokenizer else " ".join(map(str, toks)))
+            texts.append(text.strip())
+            resp.segments.append(pb.TranscriptSegment(
+                id=i, start=int(s * 1e9), end=int(e * 1e9),
+                text=text.strip(), tokens=toks))
+        resp.text = " ".join(t for t in texts if t)
+        return resp
+
+    def VAD(self, request, context):
+        from localai_tpu.audio.vad import detect_segments
+
+        audio = np.asarray(list(request.audio), np.float32)
+        resp = pb.VADResponse()
+        for s, e in detect_segments(audio):
+            resp.segments.append(pb.VADSegment(start=s, end=e))
+        return resp
+
+
+class TTSServicer(BackendServicer):
+    """DSP TTS + sound generation (reference piper/bark role)."""
+
+    def LoadModel(self, request, context):
+        return pb.Result(success=True, message="ok")
+
+    def TTS(self, request, context):
+        if not request.dst:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, "dst required")
+        from localai_tpu.audio.pcm import write_wav
+        from localai_tpu.audio.tts import synthesize
+
+        audio = synthesize(request.text, voice=request.voice or "default",
+                           language=request.language or "en")
+        write_wav(request.dst, audio, 16000)
+        return pb.Result(success=True, message=request.dst)
+
+    def SoundGeneration(self, request, context):
+        if not request.dst:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, "dst required")
+        from localai_tpu.audio.pcm import write_wav
+        from localai_tpu.audio.tts import generate_sound
+
+        audio = generate_sound(request.text,
+                               duration=request.duration or 2.0)
+        write_wav(request.dst, audio, 16000)
+        return pb.Result(success=True, message=request.dst)
+
+    def VAD(self, request, context):
+        from localai_tpu.audio.vad import detect_segments
+
+        audio = np.asarray(list(request.audio), np.float32)
+        resp = pb.VADResponse()
+        for s, e in detect_segments(audio):
+            resp.segments.append(pb.VADSegment(start=s, end=e))
+        return resp

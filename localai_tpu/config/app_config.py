@@ -22,6 +22,7 @@ class AppConfig:
     preload_models: list[str] = dataclasses.field(default_factory=list)
     log_level: str = "info"
     machine_tag: str = ""
+    max_request_bytes: int = 256 * 1024 * 1024   # body limit (app.go:45 role)
 
     @classmethod
     def from_env(cls, **overrides) -> "AppConfig":

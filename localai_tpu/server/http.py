@@ -51,7 +51,8 @@ class API:
         self.cfg = app_config
         self.configs = configs
         self.manager = manager
-        self.app = web.Application(middlewares=[self._middleware])
+        self.app = web.Application(middlewares=[self._middleware],
+                                   client_max_size=app_config.max_request_bytes)
         r = self.app.router
         r.add_get("/healthz", self._health)
         r.add_get("/readyz", self._health)
@@ -68,6 +69,11 @@ class API:
         r.add_post("/rerank", self._rerank)
         r.add_post("/v1/tokenize", self._tokenize)
         r.add_post("/tokenize", self._tokenize)
+        r.add_post("/v1/audio/transcriptions", self._transcriptions)
+        r.add_post("/v1/audio/speech", self._speech)
+        r.add_post("/tts", self._speech)
+        r.add_post("/vad", self._vad)
+        r.add_post("/sound-generation", self._sound_generation)
         r.add_get("/backend/monitor", self._backend_monitor)
         r.add_post("/backend/shutdown", self._backend_shutdown)
         r.add_get("/system", self._system)
@@ -394,6 +400,108 @@ class API:
         ok = await asyncio.to_thread(
             self.manager.stop_model, body.get("model", ""))
         return web.json_response({"success": ok})
+
+    # ------------------------------------------------------ audio endpoints
+    # (reference: endpoints/openai/transcription.go + localai tts/vad routes)
+
+    async def _transcriptions(self, request):
+        """OpenAI /v1/audio/transcriptions: multipart form (file, model)."""
+        import tempfile
+
+        form = await request.post()
+        upload = form.get("file")
+        if upload is None:
+            raise web.HTTPBadRequest(
+                text=json.dumps(schema.error_body("file field required")),
+                content_type="application/json")
+        cfg = self._resolve({"model": form.get("model", "")})
+        handle = await self._handle(cfg)
+        with tempfile.NamedTemporaryFile(suffix=".wav", delete=False) as t:
+            t.write(upload.file.read())
+            path = t.name
+        handle.mark_busy()
+        try:
+            r = await asyncio.to_thread(
+                lambda: handle.client.transcribe(
+                    dst=path, language=form.get("language", "")))
+            return web.json_response({
+                "text": r.text,
+                "segments": [{
+                    "id": s.id, "start": s.start / 1e9, "end": s.end / 1e9,
+                    "text": s.text,
+                } for s in r.segments],
+            })
+        finally:
+            handle.mark_idle()
+            import os as _os
+
+            _os.unlink(path)
+
+    async def _speech(self, request):
+        """OpenAI /v1/audio/speech + localai /tts → WAV bytes."""
+        import tempfile
+
+        body = await request.json()
+        text = body.get("input") or body.get("text") or ""
+        name = body.get("model") or "default-tts"
+        cfg = self.configs.get(name)
+        if cfg is None:
+            cfg = ModelConfig(name=name, backend="tts")
+        handle = await self._handle(cfg)
+        with tempfile.NamedTemporaryFile(suffix=".wav", delete=False) as t:
+            path = t.name
+        handle.mark_busy()
+        try:
+            await asyncio.to_thread(lambda: handle.client.tts(
+                text=text, voice=body.get("voice", ""), dst=path,
+                language=body.get("language", "")))
+            with open(path, "rb") as f:
+                data = f.read()
+            return web.Response(body=data, content_type="audio/wav")
+        finally:
+            handle.mark_idle()
+            import os as _os
+
+            _os.unlink(path)
+
+    async def _vad(self, request):
+        body = await request.json()
+        name = body.get("model") or "default-tts"
+        cfg = self.configs.get(name)
+        if cfg is None:
+            cfg = ModelConfig(name=name, backend="tts")
+        handle = await self._handle(cfg)
+        r = await asyncio.to_thread(
+            lambda: handle.client.vad(body.get("audio", [])))
+        return web.json_response({"segments": [
+            {"start": s.start, "end": s.end} for s in r.segments]})
+
+    async def _sound_generation(self, request):
+        import tempfile
+
+        body = await request.json()
+        name = body.get("model") or "default-tts"
+        cfg = self.configs.get(name)
+        if cfg is None:
+            cfg = ModelConfig(name=name, backend="tts")
+        handle = await self._handle(cfg)
+        with tempfile.NamedTemporaryFile(suffix=".wav", delete=False) as t:
+            path = t.name
+        handle.mark_busy()
+        try:
+            await asyncio.to_thread(
+                lambda: handle.client.sound_generation(
+                    text=body.get("text", body.get("input", "")),
+                    duration=float(body.get("duration_seconds", 2.0)),
+                    dst=path))
+            with open(path, "rb") as f:
+                data = f.read()
+            return web.Response(body=data, content_type="audio/wav")
+        finally:
+            handle.mark_idle()
+            import os as _os
+
+            _os.unlink(path)
 
     # ------------------------------------------------------ stores endpoints
     # (reference: localai routes + backend/go/local-store; values are strings

@@ -97,6 +97,18 @@ def test_embedding_cosine_sanity(served):
     assert sim_ab > sim_ac  # near-duplicate closer than junk
 
 
+def test_rerank(served):
+    client, _ = served
+    r = client.rerank(query="the quick brown fox",
+                      documents=["the quick brown foxes",
+                                 "zzz qqq 123",
+                                 "the quick brown fox"],
+                      top_n=2)
+    assert len(r.results) == 2
+    assert r.results[0].index == 2  # exact match ranks first
+    assert r.results[0].relevance_score >= r.results[1].relevance_score
+
+
 def test_metrics(served):
     client, _ = served
     m = client.metrics()

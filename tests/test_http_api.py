@@ -190,6 +190,31 @@ def test_metrics_endpoint(stack):
     assert b"localai_api_calls_total" in r.content
 
 
+def test_tts_and_vad_http(stack):
+    """/v1/audio/speech (implicit tts backend) returns WAV; /vad segments."""
+    import io
+    import wave
+
+    base, _ = stack
+    r = requests.post(base + "/v1/audio/speech", json={
+        "input": "hello", "voice": "default"}, timeout=120)
+    assert r.status_code == 200, r.text
+    assert r.headers["Content-Type"].startswith("audio/wav")
+    with wave.open(io.BytesIO(r.content)) as w:
+        assert w.getframerate() == 16000
+        assert w.getnframes() > 1000
+
+    rate = 16000
+    tone = (0.5 * np.sin(2 * np.pi * 300 * np.arange(rate) / rate))
+    silence = 0.001 * np.random.default_rng(0).normal(size=rate)
+    audio = np.concatenate([silence, tone, silence]).astype(np.float32)
+    r = requests.post(base + "/vad", json={"audio": audio.tolist()},
+                      timeout=120)
+    assert r.status_code == 200
+    segs = r.json()["segments"]
+    assert len(segs) == 1 and 0.8 < segs[0]["start"] < 1.3
+
+
 def test_stores_http_roundtrip(stack):
     """/stores/* endpoints spawn an implicit store backend on demand."""
     base, _ = stack
