@@ -83,9 +83,30 @@ def main(argv=None):
     def note(msg):
         print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
+    # Probe accelerator init in a subprocess first: a dead TPU tunnel hangs
+    # jax.devices() forever, and a hung bench records nothing. CPU fallback
+    # keeps the harness producing numbers.
+    use_cpu = args.cpu
+    if not use_cpu:
+        import subprocess
+
+        note("probing accelerator (120s limit)...")
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; d=jax.devices()[0]; print(d.platform)"],
+                capture_output=True, text=True, timeout=120)
+            platform = probe.stdout.strip().splitlines()[-1] if probe.stdout else ""
+            if probe.returncode != 0 or not platform:
+                note(f"probe failed (rc={probe.returncode}); falling back to CPU")
+                use_cpu = True
+        except subprocess.TimeoutExpired:
+            note("accelerator init timed out; falling back to CPU")
+            use_cpu = True
+
     import jax
 
-    if args.cpu:
+    if use_cpu:
         jax.config.update("jax_platforms", "cpu")
     note("initializing device client...")
     dev = jax.devices()[0]

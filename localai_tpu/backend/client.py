@@ -105,6 +105,10 @@ class BackendClient:
         return self._calls["GenerateImage"](pb.GenerateImageRequest(**kw),
                                             timeout=timeout)
 
+    def generate_video(self, timeout: float = 600.0, **kw) -> "pb.Result":
+        return self._calls["GenerateVideo"](pb.GenerateVideoRequest(**kw),
+                                            timeout=timeout)
+
     def stores_set(self, keys, values, timeout: float = 60.0) -> "pb.Result":
         return self._calls["StoresSet"](pb.StoresSetOptions(
             keys=[pb.StoresKey(floats=k) for k in keys],

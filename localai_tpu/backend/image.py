@@ -1,0 +1,54 @@
+"""Image/video generation backend servicer (reference: diffusers backend
+GenerateImage/GenerateVideo, /root/reference/backend/python/diffusers/
+backend.py; stablediffusion-ggml gosd.cpp)."""
+from __future__ import annotations
+
+import threading
+
+import grpc
+
+from localai_tpu.backend import pb
+from localai_tpu.backend.base import BackendServicer
+
+
+class ImageServicer(BackendServicer):
+    def __init__(self):
+        self.model = None
+        self._lock = threading.Lock()
+
+    def LoadModel(self, request, context):
+        with self._lock:
+            if self.model is None:
+                from localai_tpu.models.diffusion import DiffusionModel
+
+                self.model = DiffusionModel(seed=request.seed or 0)
+            return pb.Result(success=True, message="ok")
+
+    def GenerateImage(self, request, context):
+        if self.model is None:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, "no model")
+        if not request.dst:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, "dst required")
+        self.model.generate_image(
+            request.positive_prompt or "",
+            request.dst,
+            width=request.width or 256,
+            height=request.height or 256,
+            steps=request.step or 12,
+            seed=request.seed or 0,
+        )
+        return pb.Result(success=True, message=request.dst)
+
+    def GenerateVideo(self, request, context):
+        if self.model is None:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, "no model")
+        if not request.dst:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, "dst required")
+        self.model.generate_video(
+            request.prompt or "",
+            request.dst,
+            num_frames=request.num_frames or 8,
+            fps=request.fps or 4,
+            seed=request.seed or 0,
+        )
+        return pb.Result(success=True, message=request.dst)

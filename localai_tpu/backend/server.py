@@ -36,6 +36,13 @@ def _make_llm():
     return LLMServicer()
 
 
+@_role("image")
+def _make_image():
+    from localai_tpu.backend.image import ImageServicer
+
+    return ImageServicer()
+
+
 @_role("whisper")
 def _make_whisper():
     from localai_tpu.backend.whisper import WhisperServicer

@@ -37,6 +37,17 @@ def _add_backend(sub):
     return p
 
 
+def _add_federated(sub):
+    p = sub.add_parser("federated",
+                       help="run a federated load balancer over workers")
+    p.add_argument("--address", default="127.0.0.1:9090")
+    p.add_argument("--workers", default="",
+                   help="comma-separated worker base URLs")
+    p.add_argument("--strategy", default="least_used",
+                   choices=["least_used", "random", "round_robin"])
+    return p
+
+
 def _add_models(sub):
     p = sub.add_parser("models", help="list or install models")
     p.add_argument("action", choices=["list", "install"], nargs="?", default="list")
@@ -55,6 +66,7 @@ def main(argv=None):
     _add_run(sub)
     _add_backend(sub)
     _add_models(sub)
+    _add_federated(sub)
     sub.add_parser("version", help="print version")
 
     args = parser.parse_args(argv)
@@ -76,6 +88,10 @@ def main(argv=None):
         from localai_tpu.services.gallery import cli_models
 
         return cli_models(args)
+    if cmd == "federated":
+        from localai_tpu.federation import run_federated
+
+        return run_federated(args)
     if cmd == "run":
         from localai_tpu.server.http import run_server
 

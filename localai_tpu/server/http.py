@@ -69,6 +69,9 @@ class API:
         r.add_post("/rerank", self._rerank)
         r.add_post("/v1/tokenize", self._tokenize)
         r.add_post("/tokenize", self._tokenize)
+        r.add_post("/v1/images/generations", self._images)
+        r.add_post("/v1/videos", self._videos)
+        r.add_post("/video", self._videos)
         r.add_post("/v1/audio/transcriptions", self._transcriptions)
         r.add_post("/v1/audio/speech", self._speech)
         r.add_post("/tts", self._speech)
@@ -104,6 +107,8 @@ class API:
                         status=401)
             resp = await handler(request)
             status = resp.status
+            if self.cfg.machine_tag:  # fleet tracking (app.go:93-100)
+                resp.headers["Machine-Tag"] = self.cfg.machine_tag
             return resp
         except web.HTTPException as e:
             status = e.status
@@ -400,6 +405,75 @@ class API:
         ok = await asyncio.to_thread(
             self.manager.stop_model, body.get("model", ""))
         return web.json_response({"success": ok})
+
+    # ------------------------------------------------------ image endpoints
+    # (reference: endpoints/openai/image.go — b64_json/url response shapes)
+
+    def _media_cfg(self, body: dict, backend: str) -> ModelConfig:
+        name = body.get("model") or f"default-{backend}"
+        cfg = self.configs.get(name)
+        if cfg is None:
+            cfg = ModelConfig(name=name, backend=backend)
+        return cfg
+
+    async def _images(self, request):
+        import base64
+        import tempfile
+
+        body = await request.json()
+        cfg = self._media_cfg(body, "image")
+        handle = await self._handle(cfg)
+        size = (body.get("size") or "256x256").lower().split("x")
+        w, h = int(size[0]), int(size[1] if len(size) > 1 else size[0])
+        with tempfile.NamedTemporaryFile(suffix=".png", delete=False) as t:
+            path = t.name
+        handle.mark_busy()
+        try:
+            await asyncio.to_thread(lambda: handle.client.generate_image(
+                positive_prompt=body.get("prompt", ""),
+                negative_prompt=body.get("negative_prompt", ""),
+                width=w, height=h,
+                step=int(body.get("step", 0)),
+                seed=int(body.get("seed", 0)),
+                dst=path))
+            with open(path, "rb") as f:
+                data = f.read()
+            return web.json_response({"created": int(time.time()), "data": [
+                {"b64_json": base64.b64encode(data).decode()}]})
+        finally:
+            handle.mark_idle()
+            import os as _os
+
+            _os.unlink(path)
+
+    async def _videos(self, request):
+        import base64
+        import tempfile
+
+        body = await request.json()
+        cfg = self._media_cfg(body, "image")
+        handle = await self._handle(cfg)
+        with tempfile.NamedTemporaryFile(suffix=".gif", delete=False) as t:
+            path = t.name
+        handle.mark_busy()
+        try:
+            await asyncio.to_thread(
+                lambda: handle.client.generate_video(
+                    prompt=body.get("prompt", ""),
+                    num_frames=int(body.get("num_frames", 8)),
+                    fps=int(body.get("fps", 4)),
+                    seed=int(body.get("seed", 0)),
+                    dst=path))
+            with open(path, "rb") as f:
+                data = f.read()
+            return web.json_response({"created": int(time.time()), "data": [
+                {"b64_json": base64.b64encode(data).decode(),
+                 "mime_type": "image/gif"}]})
+        finally:
+            handle.mark_idle()
+            import os as _os
+
+            _os.unlink(path)
 
     # ------------------------------------------------------ audio endpoints
     # (reference: endpoints/openai/transcription.go + localai tts/vad routes)

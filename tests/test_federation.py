@@ -1,0 +1,128 @@
+"""Federated LB tests: selection strategies, proxying, dead-worker skip
+(reference federated_server.go semantics) against lightweight fake workers."""
+import asyncio
+import json
+import threading
+
+import pytest
+import requests
+from aiohttp import web
+
+from localai_tpu.federation import FederatedServer, Worker
+
+
+def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+class _Stack:
+    """Run a set of aiohttp apps in one background loop."""
+
+    def __init__(self):
+        self.loop = asyncio.new_event_loop()
+        self.thread = threading.Thread(target=self._run, daemon=True)
+        self.thread.start()
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    def serve(self, app) -> int:
+        port = _free_port()
+
+        async def start():
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", port)
+            await site.start()
+
+        asyncio.run_coroutine_threadsafe(start(), self.loop).result(10)
+        return port
+
+    def stop(self):
+        self.loop.call_soon_threadsafe(self.loop.stop)
+
+
+def _worker_app(name: str):
+    app = web.Application()
+
+    async def health(request):
+        return web.json_response({"status": "ok"})
+
+    async def who(request):
+        return web.json_response({"worker": name})
+
+    async def echo(request):
+        body = await request.json()
+        return web.json_response({"worker": name, "echo": body})
+
+    app.router.add_get("/healthz", health)
+    app.router.add_get("/v1/models", who)
+    app.router.add_post("/v1/chat/completions", echo)
+    return app
+
+
+@pytest.fixture(scope="module")
+def stack():
+    s = _Stack()
+    yield s
+    s.stop()
+
+
+def test_proxy_and_strategies(stack):
+    p1 = stack.serve(_worker_app("w1"))
+    p2 = stack.serve(_worker_app("w2"))
+    urls = [f"http://127.0.0.1:{p1}", f"http://127.0.0.1:{p2}"]
+
+    fed = FederatedServer(urls, strategy="round_robin")
+    fport = stack.serve(fed.app)
+    base = f"http://127.0.0.1:{fport}"
+
+    seen = set()
+    for _ in range(4):
+        r = requests.get(base + "/v1/models", timeout=10)
+        assert r.status_code == 200
+        seen.add(r.json()["worker"])
+    assert seen == {"w1", "w2"}  # round robin alternates
+
+    r = requests.post(base + "/v1/chat/completions",
+                      json={"messages": [{"role": "user", "content": "x"}]},
+                      timeout=10)
+    assert r.json()["echo"]["messages"][0]["content"] == "x"
+
+    r = requests.get(base + "/federation/workers", timeout=10)
+    info = r.json()
+    assert len(info) == 2 and all(w["total"] > 0 for w in info)
+
+
+def test_least_used_picks_idle_worker():
+    fed = FederatedServer(["http://a", "http://b"], strategy="least_used")
+    fed.workers[0].in_flight = 5
+    assert fed.pick().url == "http://b"
+    fed.workers[1].in_flight = 9
+    assert fed.pick().url == "http://a"
+
+
+def test_dead_worker_skipped(stack):
+    p1 = stack.serve(_worker_app("alive"))
+    dead_port = _free_port()  # nothing listens here
+    fed = FederatedServer([f"http://127.0.0.1:{dead_port}",
+                           f"http://127.0.0.1:{p1}"],
+                          strategy="round_robin", health_interval=0.0)
+    fport = stack.serve(fed.app)
+    base = f"http://127.0.0.1:{fport}"
+    for _ in range(3):
+        r = requests.get(base + "/v1/models", timeout=15)
+        assert r.status_code == 200
+        assert r.json()["worker"] == "alive"
+
+
+def test_bad_strategy_rejected():
+    with pytest.raises(ValueError):
+        FederatedServer(["http://x"], strategy="wat")
