@@ -69,14 +69,15 @@ class LLMServicer(BackendServicer):
             model = request.mesh_model or (len(devices) // data)
             mesh = build_mesh(MeshConfig(data=data, model=model),
                               devices[: data * model])
-        elif len(devices) > 1:
+        elif len(devices) > 1 and request.dtype not in ("int8", "q8"):
             # auto-TP over as many devices as the model dims divide into
             model = max_model_axis(cfg, len(devices))
             if model > 1:
                 mesh = build_mesh(MeshConfig(data=1, model=model),
                                   devices[:model])
 
-        params = load_params(model_dir, cfg, mesh=mesh)
+        params = load_params(model_dir, cfg, dtype=request.dtype or None,
+                             mesh=mesh)
         tok = Tokenizer.from_dir(model_dir)
         context_size = request.context_size or min(2048, cfg.max_position)
         buckets = tuple(request.prefill_buckets) or tuple(

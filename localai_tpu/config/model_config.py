@@ -60,6 +60,16 @@ class MeshShape:
 
 
 @dataclasses.dataclass
+class Pipeline:
+    """Model composition for /v1/realtime voice sessions (reference
+    ModelConfig.Pipeline, model_config.go:135-140)."""
+    vad: str = ""
+    transcription: str = ""
+    llm: str = ""
+    tts: str = ""
+
+
+@dataclasses.dataclass
 class ModelConfig:
     name: str = ""
     backend: str = "llm"             # backend role (llm|whisper|store|...)
@@ -76,6 +86,7 @@ class ModelConfig:
     prefill_buckets: list[int] = dataclasses.field(default_factory=list)
     mesh: MeshShape = dataclasses.field(default_factory=MeshShape)
     grammar: str = ""
+    pipeline: Pipeline = dataclasses.field(default_factory=Pipeline)
     known_usecases: list[str] = dataclasses.field(default_factory=list)
     # file this config came from (set by the loader)
     config_file: str = ""
@@ -86,9 +97,14 @@ class ModelConfig:
         params = d.pop("parameters", {}) or {}
         tmpl = d.pop("template", {}) or {}
         mesh = d.pop("mesh", {}) or {}
+        pipe = d.pop("pipeline", {}) or {}
         known = {f.name for f in dataclasses.fields(cls)}
         cfg = cls(**{k: v for k, v in d.items() if k in known})
         cfg.parameters = PredictionParams.from_dict(params)
+        cfg.pipeline = Pipeline(**{
+            k: v for k, v in pipe.items()
+            if k in {f.name for f in dataclasses.fields(Pipeline)}
+        })
         cfg.template = TemplateConfig(**{
             k: v for k, v in tmpl.items()
             if k in {f.name for f in dataclasses.fields(TemplateConfig)}

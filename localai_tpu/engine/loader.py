@@ -61,7 +61,8 @@ def load_config(model_dir: str, dtype: str | None = None) -> LlamaConfig:
         qkv_bias=hf.get("attention_bias", extra.get("qkv_bias", False)),
     )
     if dtype is not None:
-        kw["dtype"] = dtype
+        # int8 = weight quantization; activations/KV stay bf16
+        kw["dtype"] = "bfloat16" if dtype in ("int8", "q8") else dtype
 
     rs = hf.get("rope_scaling") or hf.get("rope_parameters") or None
     if rs and isinstance(rs, dict) and rs.get("rope_type", rs.get("type")) not in (None, "default"):
@@ -179,7 +180,16 @@ def load_params(
     stacked on a leading [L, ...] axis to match the lax.scan execution layout
     (models/llama.py init_params). With `mesh`, each stacked param is placed
     as a NamedSharding'ed jax.Array per param_specs (Megatron-style TP).
+
+    dtype="int8" loads bf16 then quantizes projections per output channel
+    (ops/quant.quantize_params — the GGUF-quant analog); currently a
+    single-chip path (param_specs doesn't cover the {q, s} leaves yet).
     """
+    quantize = dtype in ("int8", "q8")
+    if quantize:
+        if mesh is not None:
+            raise NotImplementedError("int8 quantization under a mesh")
+        dtype = "bfloat16"
     dtype = jnp.dtype(dtype) if dtype is not None else cfg.jdtype
     r = _TensorReader(model_dir)
     specs = param_specs(cfg) if mesh is not None else None
@@ -235,6 +245,10 @@ def load_params(
             )
         params["lm_head"] = put(r.get(name).T, specs["lm_head"] if specs else None)
     r.close()
+    if quantize:
+        from localai_tpu.ops.quant import quantize_params
+
+        params = quantize_params(params)
     return params
 
 
@@ -243,6 +257,6 @@ def load_model(model_dir: str, *, dtype=None, mesh=None):
     from localai_tpu.engine.tokenizer import Tokenizer
 
     cfg = load_config(model_dir, dtype=dtype)
-    params = load_params(model_dir, cfg, mesh=mesh)
+    params = load_params(model_dir, cfg, dtype=dtype, mesh=mesh)
     tok = Tokenizer.from_dir(model_dir)
     return cfg, params, tok

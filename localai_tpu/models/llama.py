@@ -27,6 +27,7 @@ from jax.sharding import PartitionSpec as P
 from localai_tpu.ops.norms import rms_norm
 from localai_tpu.ops.rope import RopeConfig, rope_table, apply_rope
 from localai_tpu.ops.attention import mha_prefill, mha_decode
+from localai_tpu.ops.quant import qmatmul
 from localai_tpu.parallel.mesh import constrain
 
 
@@ -175,9 +176,9 @@ def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int, dtype=None):
 
 def _qkv(x, lp, cfg: LlamaConfig):
     b, s, _ = x.shape
-    q = x @ lp["wq"]
-    k = x @ lp["wk"]
-    v = x @ lp["wv"]
+    q = qmatmul(x, lp["wq"])
+    k = qmatmul(x, lp["wk"])
+    v = qmatmul(x, lp["wv"])
     if cfg.qkv_bias:
         q = q + lp["bq"]
         k = k + lp["bk"]
@@ -188,8 +189,18 @@ def _qkv(x, lp, cfg: LlamaConfig):
     return q, k, v
 
 
+def _lm_head(x32, params):
+    """Vocabulary projection in f32 (tied embeddings or separate, possibly
+    int8-quantized, lm_head)."""
+    head = params.get("lm_head", None)
+    if head is None:
+        return x32 @ params["embed"].astype(jnp.float32).T
+    return qmatmul(x32, head)
+
+
 def _mlp(x, lp):
-    return (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
+    return qmatmul(jax.nn.silu(qmatmul(x, lp["w_gate"])) * qmatmul(x, lp["w_up"]),
+                   lp["w_down"])
 
 
 # Activation sharding hints: hard constraints when a mesh is active (raises on
@@ -242,7 +253,7 @@ def prefill(params, cfg: LlamaConfig, tokens, lengths, cos, sin,
         k = apply_rope(k, cos, sin, positions)
         q = _shard_act(q, P("data", None, "model", None))
         attn = attn_prefill(q, k, v, lengths, sliding_window=cfg.sliding_window)
-        x = x + attn.reshape(b, s, -1) @ lp["wo"]
+        x = x + qmatmul(attn.reshape(b, s, -1), lp["wo"])
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         x = x + _mlp(h, lp)
         x = _shard_act(x, P("data", None, None))
@@ -257,10 +268,7 @@ def prefill(params, cfg: LlamaConfig, tokens, lengths, cos, sin,
     last = jnp.take_along_axis(
         x, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1
     )[:, 0]
-    head = params.get("lm_head", None)
-    if head is None:
-        head = params["embed"].T
-    logits = (last.astype(jnp.float32) @ head.astype(jnp.float32))
+    logits = _lm_head(last.astype(jnp.float32), params)
     return logits, k_cache, v_cache
 
 
@@ -288,7 +296,7 @@ def decode_step(params, cfg: LlamaConfig, tokens, lengths, cos, sin,
         vc = vc.at[jnp.arange(b)[:, None], positions].set(v)
         attn = attn_decode(q, kc, vc, lengths + 1,
                            sliding_window=cfg.sliding_window)
-        x = x + attn.reshape(b, 1, -1) @ lp["wo"]
+        x = x + qmatmul(attn.reshape(b, 1, -1), lp["wo"])
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         x = x + _mlp(h, lp)
         return x, (kc, vc)
@@ -297,10 +305,7 @@ def decode_step(params, cfg: LlamaConfig, tokens, lengths, cos, sin,
         layer, x, (params["layers"], k_cache, v_cache)
     )
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
-    head = params.get("lm_head", None)
-    if head is None:
-        head = params["embed"].T
-    logits = x[:, 0].astype(jnp.float32) @ head.astype(jnp.float32)
+    logits = _lm_head(x[:, 0].astype(jnp.float32), params)
     return logits, k_cache, v_cache
 
 
@@ -323,7 +328,7 @@ def hidden_states(params, cfg: LlamaConfig, tokens, lengths=None):
         k = apply_rope(k, cos, sin, positions)
         q = _shard_act(q, P("data", None, "model", None))
         attn = attn_prefill(q, k, v, lengths, sliding_window=cfg.sliding_window)
-        x = x + attn.reshape(b, s, -1) @ lp["wo"]
+        x = x + qmatmul(attn.reshape(b, s, -1), lp["wo"])
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         x = x + _mlp(h, lp)
         x = _shard_act(x, P("data", None, None))
@@ -356,7 +361,7 @@ def extend(params, cfg: LlamaConfig, tokens, start, cos, sin,
         vc = vc.at[jnp.arange(b)[:, None], positions].set(v)
         attn = mha_extend(q, kc, vc, positions,
                           sliding_window=cfg.sliding_window)
-        x = x + attn.reshape(b, s, -1) @ lp["wo"]
+        x = x + qmatmul(attn.reshape(b, s, -1), lp["wo"])
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         x = x + _mlp(h, lp)
         return x, (kc, vc)
@@ -365,20 +370,14 @@ def extend(params, cfg: LlamaConfig, tokens, start, cos, sin,
         layer, x, (params["layers"], k_cache, v_cache)
     )
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
-    head = params.get("lm_head", None)
-    if head is None:
-        head = params["embed"].T
-    logits = x.astype(jnp.float32) @ head.astype(jnp.float32)
+    logits = _lm_head(x.astype(jnp.float32), params)
     return logits, k_cache, v_cache
 
 
 def forward_train(params, cfg: LlamaConfig, tokens):
     """Full-sequence causal forward → logits [B, S, V] (training / eval path)."""
     x = hidden_states(params, cfg, tokens)
-    head = params.get("lm_head", None)
-    if head is None:
-        head = params["embed"].T
-    return x.astype(jnp.float32) @ head.astype(jnp.float32)
+    return _lm_head(x.astype(jnp.float32), params)
 
 
 def encode_pooled(params, cfg: LlamaConfig, tokens, lengths, normalize=True):

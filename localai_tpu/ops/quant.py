@@ -1,0 +1,58 @@
+"""Weight quantization: per-channel symmetric int8 — the TPU answer to
+llama.cpp's GGUF quants (reference ModelOptions dtype/quant surface,
+/root/reference/backend/backend.proto:175-265; F16Memory/LowVRAM knobs).
+
+A quantized tensor is {"q": int8 [.., in, out], "s": f32 [.., 1, out]}
+(per-output-channel scales). `qmatmul` computes x @ (q * s) with the scale
+folded AFTER the int8→bf16 cast so XLA fuses dequant into the matmul epilogue;
+HBM traffic halves vs bf16, which is what decode throughput is bound by.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def quantize(w, axis: int = -1):
+    """f32/bf16 weight → {"q": int8, "s": f32} with scales on `axis` kept."""
+    w32 = jnp.asarray(w, jnp.float32)
+    amax = jnp.max(jnp.abs(w32), axis=tuple(
+        i for i in range(w32.ndim) if i != (axis % w32.ndim)), keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+    return {"q": q, "s": scale.astype(jnp.float32)}
+
+
+def is_quantized(p) -> bool:
+    return isinstance(p, dict) and set(p.keys()) == {"q", "s"}
+
+
+def dequantize(p, dtype=jnp.bfloat16):
+    return (p["q"].astype(jnp.float32) * p["s"]).astype(dtype)
+
+
+def qmatmul(x, p):
+    """x @ W for a (possibly) quantized W; activations keep their dtype."""
+    if not is_quantized(p):
+        return x @ p
+    # int8 → activation dtype, scale folded per output channel
+    w = p["q"].astype(x.dtype)
+    y = x @ w
+    return y * p["s"].reshape((1,) * (y.ndim - 1) + (-1,)).astype(y.dtype)
+
+
+def quantize_params(params, *, skip=("embed", "final_norm")):
+    """Quantize every projection matrix in a llama param tree (norms, biases
+    and embeddings stay high-precision, like llama.cpp's mixed layouts)."""
+    out = {}
+    for k, v in params.items():
+        if k == "layers":
+            out[k] = {
+                lk: (quantize(lv) if lk.startswith("w") else lv)
+                for lk, lv in v.items()
+            }
+        elif k == "lm_head":
+            out[k] = quantize(v)
+        else:
+            out[k] = v
+    return out

@@ -69,6 +69,7 @@ class API:
         r.add_post("/rerank", self._rerank)
         r.add_post("/v1/tokenize", self._tokenize)
         r.add_post("/tokenize", self._tokenize)
+        r.add_get("/v1/realtime", self._realtime)
         r.add_post("/v1/images/generations", self._images)
         r.add_post("/v1/videos", self._videos)
         r.add_post("/video", self._videos)
@@ -405,6 +406,11 @@ class API:
         ok = await asyncio.to_thread(
             self.manager.stop_model, body.get("model", ""))
         return web.json_response({"success": ok})
+
+    async def _realtime(self, request):
+        from localai_tpu.server.realtime import realtime_handler
+
+        return await realtime_handler(self, request)
 
     # ------------------------------------------------------ image endpoints
     # (reference: endpoints/openai/image.go — b64_json/url response shapes)

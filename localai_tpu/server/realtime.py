@@ -1,0 +1,200 @@
+"""/v1/realtime — WebSocket voice sessions composing VAD → transcription →
+LLM → TTS from the model's `pipeline:` config.
+
+Reference: /root/reference/core/http/endpoints/openai/realtime.go:179-1301
+(session state machine :130/:605, audio ring buffer + VAD goroutine :644-858,
+utterance commit → pipeline models, events back over WS :542). This is the
+commit-driven subset of that machine: explicit input_audio_buffer.commit (or
+text conversation items) triggers the pipeline; server-VAD auto-commit mode
+triggers on trailing silence after speech.
+
+Event surface (OpenAI-realtime-shaped):
+  client → server: session.update, conversation.item.create,
+                   input_audio_buffer.append (b64 pcm16 @16 kHz),
+                   input_audio_buffer.commit, response.create
+  server → client: session.created, conversation.item.created,
+                   input_audio_buffer.committed,
+                   conversation.item.input_audio_transcription.completed,
+                   response.text.delta, response.audio.delta (b64 wav pcm16),
+                   response.done, error
+"""
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import tempfile
+import uuid
+
+import numpy as np
+from aiohttp import WSMsgType, web
+
+
+class RealtimeSession:
+    def __init__(self, api, cfg):
+        self.api = api
+        self.cfg = cfg                      # ModelConfig with .pipeline
+        self.messages: list[dict] = []
+        self.audio = bytearray()            # pcm16 @16 kHz
+        self.session_id = f"sess_{uuid.uuid4().hex[:16]}"
+        self.voice = "default"
+        self.server_vad = False
+
+    # ---------------------------------------------------------- pipeline ops
+
+    async def _handle_for(self, name: str):
+        mcfg = self.api.configs.get(name)
+        if mcfg is None:
+            if not name.startswith("default-"):
+                raise ValueError(f"pipeline model {name!r} not found")
+            from localai_tpu.config import ModelConfig
+
+            mcfg = ModelConfig(name=name, backend=name.split("-", 1)[1])
+        return await self.api._handle(mcfg)
+
+    async def transcribe_buffer(self) -> str:
+        name = self.cfg.pipeline.transcription
+        if not name:
+            return ""
+        from localai_tpu.audio.pcm import i16_to_f32, write_wav
+
+        pcm = np.frombuffer(bytes(self.audio), np.int16)
+        handle = await self._handle_for(name)
+        with tempfile.NamedTemporaryFile(suffix=".wav", delete=False) as t:
+            path = t.name
+        import os
+
+        try:
+            write_wav(path, i16_to_f32(pcm), 16000)
+            r = await asyncio.to_thread(
+                lambda: handle.client.transcribe(dst=path))
+            return r.text
+        finally:
+            os.unlink(path)
+
+    async def run_llm(self) -> str:
+        name = self.cfg.pipeline.llm or self.cfg.name
+        handle = await self._handle_for(name)
+        mcfg = self.api.configs.get(name) or self.cfg
+        opts = self.api._merged_options(mcfg, {})
+        opts["messages_json"] = json.dumps(self.messages)
+        opts["use_tokenizer_template"] = True
+        reply = await asyncio.to_thread(
+            lambda: handle.client.predict(**opts))
+        return reply.message.decode("utf-8", "replace")
+
+    async def run_tts(self, text: str) -> bytes:
+        name = self.cfg.pipeline.tts
+        if not name:
+            return b""
+        handle = await self._handle_for(name)
+        with tempfile.NamedTemporaryFile(suffix=".wav", delete=False) as t:
+            path = t.name
+        import os
+
+        try:
+            await asyncio.to_thread(lambda: handle.client.tts(
+                text=text, voice=self.voice, dst=path))
+            with open(path, "rb") as f:
+                return f.read()
+        finally:
+            os.unlink(path)
+
+    def vad_has_utterance(self) -> bool:
+        """Server-VAD: speech followed by >=300 ms of silence."""
+        from localai_tpu.audio.pcm import i16_to_f32
+        from localai_tpu.audio.vad import detect_segments
+
+        pcm = i16_to_f32(np.frombuffer(bytes(self.audio), np.int16))
+        if len(pcm) < 16000 // 2:
+            return False
+        segs = detect_segments(pcm)
+        if not segs:
+            return False
+        return (len(pcm) / 16000.0 - segs[-1][1]) >= 0.3
+
+
+async def realtime_handler(api, request: web.Request):
+    name = request.query.get("model", "")
+    cfg = api.configs.get(name) if name else api.configs.first()
+    if cfg is None:
+        raise web.HTTPNotFound(text="no model for realtime session")
+
+    ws = web.WebSocketResponse()
+    await ws.prepare(request)
+    sess = RealtimeSession(api, cfg)
+
+    async def send(obj):
+        await ws.send_json(obj)
+
+    await send({"type": "session.created",
+                "session": {"id": sess.session_id, "model": cfg.name}})
+
+    async def commit_and_respond():
+        if sess.audio:
+            await send({"type": "input_audio_buffer.committed"})
+            text = await sess.transcribe_buffer()
+            sess.audio.clear()
+            if text:
+                await send({
+                    "type": "conversation.item.input_audio_transcription.completed",
+                    "transcript": text})
+                sess.messages.append({"role": "user", "content": text})
+        await respond()
+
+    async def respond():
+        if not sess.messages:
+            await send({"type": "error",
+                        "error": {"message": "no conversation items"}})
+            return
+        text = await sess.run_llm()
+        rid = f"resp_{uuid.uuid4().hex[:12]}"
+        await send({"type": "response.text.delta", "response_id": rid,
+                    "delta": text})
+        sess.messages.append({"role": "assistant", "content": text})
+        audio = await sess.run_tts(text)
+        if audio:
+            await send({"type": "response.audio.delta", "response_id": rid,
+                        "delta": base64.b64encode(audio).decode()})
+        await send({"type": "response.done", "response_id": rid})
+
+    async for msg in ws:
+        if msg.type != WSMsgType.TEXT:
+            continue
+        try:
+            ev = json.loads(msg.data)
+        except json.JSONDecodeError:
+            await send({"type": "error",
+                        "error": {"message": "invalid JSON"}})
+            continue
+        t = ev.get("type")
+        try:
+            if t == "session.update":
+                s = ev.get("session", {})
+                sess.voice = s.get("voice", sess.voice)
+                td = s.get("turn_detection")
+                sess.server_vad = bool(td and td.get("type") == "server_vad")
+                await send({"type": "session.updated", "session": s})
+            elif t == "conversation.item.create":
+                item = ev.get("item", {})
+                content = item.get("content", "")
+                if isinstance(content, list):
+                    content = "".join(p.get("text", "") for p in content)
+                sess.messages.append({
+                    "role": item.get("role", "user"), "content": content})
+                await send({"type": "conversation.item.created"})
+            elif t == "input_audio_buffer.append":
+                sess.audio.extend(base64.b64decode(ev.get("audio", "")))
+                if sess.server_vad and sess.vad_has_utterance():
+                    await commit_and_respond()
+            elif t == "input_audio_buffer.commit":
+                await commit_and_respond()
+            elif t == "response.create":
+                await respond()
+            else:
+                await send({"type": "error",
+                            "error": {"message": f"unknown event {t!r}"}})
+        except Exception as e:
+            await send({"type": "error",
+                        "error": {"message": f"{type(e).__name__}: {e}"}})
+    return ws

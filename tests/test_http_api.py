@@ -51,6 +51,10 @@ def stack(tmp_path_factory):
             "temperature": 0.0,
             "max_tokens": 8,
         },
+        "pipeline": {
+            "llm": "tiny",
+            "tts": "default-tts",
+        },
     }))
 
     os.environ["LOCALAI_JAX_PLATFORM"] = "cpu"
@@ -254,6 +258,46 @@ def test_response_format_json_object(stack):
     assert content.startswith("{")
     if r.json()["choices"][0]["finish_reason"] in ("stop", "eos"):
         json.loads(content)
+
+
+def test_realtime_websocket_text_session(stack):
+    """WS session: item.create + response.create → text delta + TTS audio
+    delta + done (the reference's realtime pipeline composition)."""
+    import base64
+    import io
+    import wave
+
+    from websockets.sync.client import connect
+
+    base, _ = stack
+    url = base.replace("http://", "ws://") + "/v1/realtime?model=tiny"
+    with connect(url, open_timeout=30) as ws:
+        first = json.loads(ws.recv(timeout=30))
+        assert first["type"] == "session.created"
+        assert first["session"]["model"] == "tiny"
+
+        ws.send(json.dumps({"type": "conversation.item.create",
+                            "item": {"role": "user", "content": "hello"}}))
+        assert json.loads(ws.recv(timeout=30))["type"] == \
+            "conversation.item.created"
+
+        ws.send(json.dumps({"type": "response.create"}))
+        events = {}
+        for _ in range(4):
+            ev = json.loads(ws.recv(timeout=600))
+            events[ev["type"]] = ev
+            if ev["type"] == "response.done":
+                break
+        assert "response.text.delta" in events
+        assert "response.audio.delta" in events
+        assert "response.done" in events
+        wav_bytes = base64.b64decode(events["response.audio.delta"]["delta"])
+        with wave.open(io.BytesIO(wav_bytes)) as w:
+            assert w.getnframes() > 0
+
+        # unknown event type surfaces an error event, session stays alive
+        ws.send(json.dumps({"type": "bogus.event"}))
+        assert json.loads(ws.recv(timeout=30))["type"] == "error"
 
 
 def test_kill9_backend_recovers(stack):
