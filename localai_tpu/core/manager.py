@@ -71,7 +71,14 @@ class ModelManager:
     def _spawn(self, cfg: ModelConfig) -> BackendHandle:
         port = free_port()
         env = dict(os.environ)
-        env.setdefault("PYTHONPATH", os.getcwd())
+        # child must import localai_tpu regardless of the parent's cwd, and
+        # existing PYTHONPATH entries (e.g. a site hook registering the TPU
+        # PJRT plugin) must survive — prepend, never replace
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        parts = [pkg_root] + [
+            p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p]
+        env["PYTHONPATH"] = os.pathsep.join(dict.fromkeys(parts))
         proc = subprocess.Popen(
             [sys.executable, "-m", "localai_tpu.backend",
              "--addr", f"127.0.0.1:{port}", "--backend", cfg.backend],

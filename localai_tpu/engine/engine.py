@@ -148,6 +148,7 @@ class Engine:
         self._queue: "queue.Queue[tuple[int, GenRequest, queue.Queue]]" = queue.Queue()
         self._next_id = 0
         self._lock = threading.Lock()
+        self._grammar_lock = threading.Lock()
         self._wake = threading.Event()
         self._running = False
         self._dead = False
@@ -228,6 +229,11 @@ class Engine:
         V = self.cfg.vocab_size
         if any(not (0 <= t < V) for t in req.prompt_ids):
             raise ValueError(f"prompt token id outside [0, {V})")
+        if req.grammar:
+            # compile now (cached) so a malformed GBNF rejects THIS call with
+            # ValueError → gRPC INVALID_ARGUMENT, instead of surfacing later
+            # as an in-band admission error
+            self._compile_grammar(req.grammar)
         with self._lock:
             rid = self._next_id
             self._next_id += 1
@@ -244,20 +250,40 @@ class Engine:
                 return b
         raise ValueError(f"prompt too long: {n}")
 
+    def _compile_grammar(self, grammar: str):
+        """Compile (or fetch cached) GBNF → CompiledGrammar. Called from gRPC
+        handler threads (submit-time validation) AND the engine loop thread,
+        so both the lazy init and the cache access are lock-protected."""
+        with self._grammar_lock:
+            if self._grammar_cache is None:
+                if self.tok is None:
+                    raise ValueError("grammar constraint requires a tokenizer")
+                from localai_tpu.functions.matcher import GrammarCache
+
+                self._grammar_cache = GrammarCache(self.tok)
+            return self._grammar_cache.get(grammar)
+
     def _matcher_for(self, grammar: str):
-        if self._grammar_cache is None:
-            if self.tok is None:
-                raise ValueError("grammar constraint requires a tokenizer")
-            from localai_tpu.functions.matcher import GrammarCache
+        return self._compile_grammar(grammar).state()
 
-            self._grammar_cache = GrammarCache(self.tok)
-        return self._grammar_cache.get(grammar).state()
-
-    def _admit_one(self, rid: int, req: GenRequest, out: queue.Queue):
-        matcher = self._matcher_for(req.grammar) if req.grammar else None
+    def _admit_one(self, rid: int, req: GenRequest, out: queue.Queue) -> bool:
+        # Host-side per-request failures (bad GBNF, missing tokenizer, prompt
+        # too long) must reject THIS request only — never kill the loop, which
+        # would strand every other in-flight stream (the reference rejects a
+        # bad grammar per-request in the sampler). Device failures below are
+        # engine-fatal on purpose: donation makes the state unrecoverable.
+        try:
+            matcher = self._matcher_for(req.grammar) if req.grammar else None
+            n = len(req.prompt_ids)
+            bucket = self._bucket(n)
+        except Exception:
+            out.put(StepOutput(
+                request_id=rid, text="", token_id=-1,
+                logprob=0.0, finished=True, finish_reason="error",
+                prompt_tokens=len(req.prompt_ids),
+            ))
+            return False
         slot = self._free.pop()
-        n = len(req.prompt_ids)
-        bucket = self._bucket(n)
         ids = np.zeros((1, bucket), np.int32)
         ids[0, :n] = req.prompt_ids
         counts_row = np.zeros((self.cfg.vocab_size,), np.int32)
@@ -286,6 +312,7 @@ class Engine:
             self._mask_host[slot] = matcher.mask_bits(eos)
             self._grammar_slots += 1
         self.metrics["prompt_tokens_processed"] += n
+        return True
 
     def _active_mask(self) -> np.ndarray:
         return np.array([s is not None for s in self._slots], bool)

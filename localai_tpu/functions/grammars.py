@@ -129,23 +129,35 @@ class _Converter:
         required = set(schema.get("required", list(props)))
         if not props:
             return self._prim("object")
-        parts = []
-        first = True
         # fixed property order (sorted required-first) keeps the grammar
         # regular — same simplification the reference makes
         ordered = [k for k in props if k in required] + [
             k for k in props if k not in required
         ]
+        kvs = {}
         for k in ordered:
             sub = self.visit(props[k], f"{name}-{k}")
-            kv = f'{_literal(json.dumps(k))} space ":" space {sub}'
-            if k in required:
-                sep = "" if first else '"," space '
-                parts.append(f"{sep}{kv}")
-                first = False
-            else:
-                sep = '"," space ' if not first else ""
-                parts.append(f"({sep}{kv})?")
+            kvs[k] = f'{_literal(json.dumps(k))} space ":" space {sub}'
+
+        req = [k for k in ordered if k in required]
+        opt = [k for k in ordered if k not in required]
+        parts = []
+        for i, k in enumerate(req):
+            sep = "" if i == 0 else '"," space '
+            parts.append(f"{sep}{kvs[k]}")
+        if req:
+            # a required property always precedes, so every optional is an
+            # independent comma-prefixed group
+            parts.extend(f'("," space {kvs[k]})?' for k in opt)
+        elif opt:
+            # all-optional object: alternate on which property appears first
+            # (cf. reference json_schema.go) — the first emitted property has
+            # no comma, each later one keeps its own
+            alts = []
+            for i, k in enumerate(opt):
+                tail = "".join(f' ("," space {kvs[j]})?' for j in opt[i + 1:])
+                alts.append(f"{kvs[k]}{tail}")
+            parts.append("(" + " | ".join(alts) + ")?")
         prod = '"{" space ' + " ".join(parts) + ' "}" space'
         return self._add(name, prod)
 

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import threading
 import time
 
 from aiohttp import web
@@ -185,25 +186,48 @@ class API:
     async def _stream_rpc(self, handle, opts: dict):
         """Bridge the blocking gRPC stream into an async queue."""
         loop = asyncio.get_running_loop()
+        # Bounded queue + BLOCKING put from the pump thread: backpressure
+        # propagates to the gRPC stream instead of dropping chunks (or the
+        # terminal sentinel) when the HTTP client reads slower than the
+        # backend decodes. `stopped` ends the pump when the client goes away
+        # so an abandoned stream doesn't buffer the rest of the generation.
         q: asyncio.Queue = asyncio.Queue(maxsize=256)
+        stopped = threading.Event()
 
         def pump():
             try:
                 for reply in handle.client.predict_stream(**opts):
-                    loop.call_soon_threadsafe(q.put_nowait, ("chunk", reply))
-                loop.call_soon_threadsafe(q.put_nowait, ("done", None))
+                    if stopped.is_set():
+                        return
+                    asyncio.run_coroutine_threadsafe(
+                        q.put(("chunk", reply)), loop).result()
+                    if stopped.is_set():
+                        return
+                asyncio.run_coroutine_threadsafe(
+                    q.put(("done", None)), loop).result()
             except Exception as e:
-                loop.call_soon_threadsafe(q.put_nowait, ("error", e))
+                if not stopped.is_set():
+                    try:
+                        asyncio.run_coroutine_threadsafe(
+                            q.put(("error", e)), loop).result()
+                    except Exception:
+                        pass
 
         loop.run_in_executor(None, pump)
-        while True:
-            kind, item = await q.get()
-            if kind == "chunk":
-                yield item
-            elif kind == "done":
-                return
-            else:
-                raise item
+        try:
+            while True:
+                kind, item = await q.get()
+                if kind == "chunk":
+                    yield item
+                elif kind == "done":
+                    return
+                else:
+                    raise item
+        finally:
+            stopped.set()
+            # unblock a pump stuck in a full-queue put
+            while not q.empty():
+                q.get_nowait()
 
     # ------------------------------------------------------------ endpoints
 

@@ -78,6 +78,17 @@ class Gallery:
         return self.models().get(name)
 
 
+def _confine(root: str, relpath: str) -> str:
+    """Resolve `relpath` under `root` and refuse any escape — gallery indexes
+    are untrusted input (reference verifyPath, core/gallery/models.go; this
+    was a CVE class upstream). Rejects absolute paths, `..`, and symlink
+    escapes alike by comparing realpaths."""
+    dest = os.path.realpath(os.path.join(root, relpath))
+    if dest == root or os.path.commonpath([root, dest]) != root:
+        raise ValueError(f"path traversal in gallery path {relpath!r}")
+    return dest
+
+
 def install_model(gallery: Gallery, name: str, models_path: str,
                   progress=None, overrides: dict | None = None) -> str:
     """Download a gallery model's files and write its ModelConfig YAML.
@@ -86,11 +97,12 @@ def install_model(gallery: Gallery, name: str, models_path: str,
     if gm is None:
         raise KeyError(f"model {name!r} not in galleries")
     os.makedirs(models_path, exist_ok=True)
-    for f in gm.files:
-        dest = os.path.join(models_path, f["filename"])
-        if os.path.realpath(dest).startswith(os.path.realpath("/")) and \
-                ".." in f["filename"]:
-            raise ValueError(f"path traversal in gallery file {f['filename']!r}")
+    root = os.path.realpath(models_path)
+    # confine every destination (including the YAML) BEFORE fetching anything:
+    # a malicious name must not cost bandwidth first
+    ypath = _confine(root, f"{name}.yaml")
+    dests = [_confine(root, f["filename"]) for f in gm.files]
+    for f, dest in zip(gm.files, dests):
         download_file(f["uri"], dest, sha256=f.get("sha256"),
                       progress=progress)
     cfg: dict[str, Any] = {"name": name,
@@ -98,7 +110,6 @@ def install_model(gallery: Gallery, name: str, models_path: str,
     cfg.update(gm.config or {})
     cfg.update(overrides or {})
     cfg.setdefault("name", name)
-    ypath = os.path.join(models_path, f"{name}.yaml")
     with open(ypath, "w") as f:
         yaml.safe_dump(cfg, f, sort_keys=False)
     return ypath

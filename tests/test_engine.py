@@ -11,6 +11,7 @@ import jax.numpy as jnp
 from localai_tpu.engine import (
     Engine, EngineConfig, GenRequest, Tokenizer, load_config, load_params,
 )
+from localai_tpu.functions.grammars import JSON_GRAMMAR
 from localai_tpu.models.llama import forward_train
 from localai_tpu.ops.sampling import SamplingParams
 
@@ -181,3 +182,41 @@ def test_incremental_detok_utf8(loaded):
     text = "".join(dec.push(i) for i in ids)
     assert "�" not in text
     assert text == tok.decode(ids)
+
+
+def test_bad_grammar_fails_request_not_engine(loaded):
+    """Client-reachable admission failures must reject that request only and
+    leave the engine serving others (advisor finding: an admission exception
+    bricked the whole engine). Two layers: malformed GBNF raises ValueError at
+    submit() (→ gRPC INVALID_ARGUMENT); anything slipping to admission time is
+    converted to a terminal finish_reason=error StepOutput."""
+    cfg, params, tok = loaded
+    eng = Engine(cfg, params, tok, EngineConfig(max_slots=2, max_context=128,
+                                                prefill_buckets=(32,)))
+    bad = GenRequest(tok.encode("hello"), SamplingParams(temperature=0.0),
+                     max_tokens=4, ignore_eos=True,
+                     grammar="root ::= (")
+    with pytest.raises(ValueError, match="grammar"):
+        eng.submit(bad)
+
+    # admission-time failure (defensive layer): force the matcher compile to
+    # blow up only inside _admit_one
+    ok = GenRequest(tok.encode("hi"), SamplingParams(temperature=0.0),
+                    max_tokens=4, ignore_eos=True, grammar=JSON_GRAMMAR)
+    good = GenRequest(tok.encode("hello"), SamplingParams(temperature=0.0),
+                      max_tokens=4, ignore_eos=True)
+    _, bad_q = eng.submit(ok)
+    orig = eng._matcher_for
+    eng._matcher_for = lambda g: (_ for _ in ()).throw(ValueError("boom"))
+    _, good_q = eng.submit(good)
+    for _ in range(50):
+        if not eng.step():
+            break
+    eng._matcher_for = orig
+    o = bad_q.get_nowait()
+    assert o.finished and o.finish_reason == "error"
+    outs = []
+    while not good_q.empty():
+        outs.append(good_q.get_nowait())
+    assert outs and outs[-1].finished and outs[-1].finish_reason == "length"
+    assert not eng._dead

@@ -134,3 +134,21 @@ def test_capability_detection_forced(monkeypatch):
     monkeypatch.delenv("LOCALAI_FORCE_CAPABILITY")
     capabilities.detect_capability.cache_clear()
     assert capabilities.detect_capability() == "cpu"  # tests force CPU
+
+
+def test_gallery_path_traversal_rejected(gallery_fixture, tmp_path):
+    """Untrusted index filenames must stay confined to the models dir
+    (reference verifyPath; an upstream CVE class)."""
+    models = tmp_path / "models"
+    g = Gallery([str(gallery_fixture)])
+    gm = g.get("demo-model")
+    for evil in ("../escape.yaml", "/etc/cron.d/x", "a/../../b"):
+        gm.files = [{"filename": evil, "uri": "file:///dev/null"}]
+        with pytest.raises(ValueError, match="path traversal"):
+            install_model(g, "demo-model", str(models))
+    # a malicious model NAME must not escape either (YAML path)
+    gm.files = []
+    gm.name = "../../evil"
+    g._models["../../evil"] = gm
+    with pytest.raises(ValueError, match="path traversal"):
+        install_model(g, "../../evil", str(models))

@@ -142,3 +142,30 @@ def test_mixed_grammar_and_free_slots(loaded):
     # the unconstrained greedy request is unaffected by its neighbor's mask
     assert texts[r2[0]] == ref_text
     assert texts[r1[0]].startswith("{")
+
+
+def test_all_optional_object_commas():
+    """Schemas with no required properties must still force commas between
+    emitted properties (advisor finding: first-flag never cleared)."""
+    schema = {"type": "object",
+              "properties": {"a": {"type": "integer"},
+                             "b": {"type": "integer"}},
+              "required": []}
+    g = json_schema_grammar(schema)
+    vocab = ['{', '}', '"a"', '"b"', ':', ',', '1', ' ']
+    s = CompiledGrammar(g, vocab).state()
+
+    def allowed():
+        bits = s.mask_bits()
+        return {vocab[i] for i in range(len(vocab))
+                if bits[i >> 3] >> (i & 7) & 1}
+
+    for t in ['{', '"a"', ':', '1']:
+        assert s.accept(vocab.index(t)), t
+    # after the first property, '"b"' may NOT follow without a comma
+    assert '"b"' not in allowed()
+    assert ',' in allowed() and '}' in allowed()
+    assert s.accept(vocab.index(','))
+    for t in ['"b"', ':', '1', '}']:
+        assert s.accept(vocab.index(t)), t
+    assert s.done
