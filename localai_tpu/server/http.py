@@ -193,25 +193,36 @@ class API:
         # so an abandoned stream doesn't buffer the rest of the generation.
         q: asyncio.Queue = asyncio.Queue(maxsize=256)
         stopped = threading.Event()
+        call = handle.client.predict_stream(**opts)
+
+        def _put(item) -> bool:
+            """Blocking put with backpressure; bounded waits so a stopped
+            consumer (or a dead event loop) can never wedge the pump thread."""
+            while not stopped.is_set():
+                fut = asyncio.run_coroutine_threadsafe(q.put(item), loop)
+                try:
+                    fut.result(timeout=1.0)
+                    return True
+                except TimeoutError:
+                    if not fut.cancel():
+                        try:
+                            fut.result(timeout=0)
+                            return True
+                        except Exception:
+                            return False
+                except Exception:
+                    return False
+            return False
 
         def pump():
             try:
-                for reply in handle.client.predict_stream(**opts):
-                    if stopped.is_set():
+                for reply in call:
+                    if not _put(("chunk", reply)):
                         return
-                    asyncio.run_coroutine_threadsafe(
-                        q.put(("chunk", reply)), loop).result()
-                    if stopped.is_set():
-                        return
-                asyncio.run_coroutine_threadsafe(
-                    q.put(("done", None)), loop).result()
+                _put(("done", None))
             except Exception as e:
                 if not stopped.is_set():
-                    try:
-                        asyncio.run_coroutine_threadsafe(
-                            q.put(("error", e)), loop).result()
-                    except Exception:
-                        pass
+                    _put(("error", e))
 
         loop.run_in_executor(None, pump)
         try:
@@ -225,7 +236,9 @@ class API:
                     raise item
         finally:
             stopped.set()
-            # unblock a pump stuck in a full-queue put
+            # cancelling the RPC unblocks a pump waiting on the next reply
+            # (client gone mid-generation) and tells the backend to stop
+            call.cancel()
             while not q.empty():
                 q.get_nowait()
 
