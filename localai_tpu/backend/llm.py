@@ -80,13 +80,17 @@ class LLMServicer(BackendServicer):
                              mesh=mesh)
         tok = Tokenizer.from_dir(model_dir)
         context_size = request.context_size or min(2048, cfg.max_position)
+        # single-shot prefill up to the chunk size; longer prompts prefill in
+        # chunk-sized pieces interleaved with running decodes
+        chunk = min(512, context_size)
         buckets = tuple(request.prefill_buckets) or tuple(
-            b for b in (64, 256, 1024, 4096) if b <= context_size
-        ) or (context_size,)
+            b for b in (64, 256, 512) if b <= chunk
+        ) or (chunk,)
         self.engine = Engine(cfg, params, tok, EngineConfig(
             max_slots=request.parallel or 4,
             max_context=context_size,
             prefill_buckets=buckets,
+            prefill_chunk=chunk,
             mesh=mesh,
         ))
         if request.embeddings:

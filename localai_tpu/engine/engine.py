@@ -39,6 +39,7 @@ import numpy as np
 from localai_tpu.models.llama import (
     LlamaConfig,
     decode_step,
+    extend,
     init_kv_cache,
     prefill,
 )
@@ -59,6 +60,8 @@ class EngineConfig:
     max_slots: int = 4            # n_parallel — concurrent sequences
     max_context: int = 1024       # n_ctx per slot
     prefill_buckets: tuple[int, ...] = (64, 256, 1024)
+    prefill_chunk: int = 256      # chunked-prefill window (tokens/engine tick)
+    pipeline: bool = True         # keep one decode step in flight
     dtype: str | None = None      # default: model dtype
     mesh: Any | None = None       # jax.sharding.Mesh for TP/DP sharding
 
@@ -102,6 +105,10 @@ class _Slot:
     start_time: float = 0.0
     first_token_time: float | None = None
     prompt_len: int = 0
+    prefilled: bool = True           # False while chunked prefill in progress
+    prefill_pos: int = 0             # prompt tokens already written to KV
+    row: Any = None                  # sampler row (installed at final chunk)
+    counts_row: Any = None
 
 
 class Engine:
@@ -145,6 +152,26 @@ class Engine:
         # host-side slot table
         self._slots: list[_Slot | None] = [None] * B
         self._free: list[int] = list(range(B))
+        # chunked prefill: chunk window + the buckets small enough to prefill
+        # single-shot without stalling running decodes longer than one chunk
+        if self.ec.prefill_chunk < 8:
+            raise ValueError("prefill_chunk must be >= 8")
+        self._chunk = min(self.ec.prefill_chunk, self.ec.max_context)
+        small = tuple(b for b in self.ec.prefill_buckets if b <= self._chunk)
+        dropped = tuple(b for b in self.ec.prefill_buckets if b > self._chunk)
+        if dropped:
+            import warnings
+
+            warnings.warn(
+                f"prefill buckets {dropped} exceed prefill_chunk="
+                f"{self._chunk}; prompts longer than "
+                f"{max(small) if small else self._chunk} tokens will prefill "
+                f"in {self._chunk}-token chunks instead of single-shot",
+                stacklevel=3)
+        self._small_buckets = small or (self._chunk,)
+        self._small_max = max(self._small_buckets)
+        self._prefillq: list[int] = []   # slot indices mid-prefill, FIFO
+        self._pending = None             # in-flight decode (pipeline depth 1)
         self._queue: "queue.Queue[tuple[int, GenRequest, queue.Queue]]" = queue.Queue()
         self._next_id = 0
         self._lock = threading.Lock()
@@ -170,6 +197,16 @@ class Engine:
     def _build_jit(self):
         cfg = self.cfg
 
+        def _install_row(sampler, slot, row, counts_row):
+            new_fields = {}
+            for f in dataclasses.fields(SamplerState):
+                cur = getattr(sampler, f.name)
+                if f.name == "token_counts":
+                    new_fields[f.name] = cur.at[slot].set(counts_row)
+                else:
+                    new_fields[f.name] = cur.at[slot].set(row[f.name])
+            return SamplerState(**new_fields)
+
         def _admit(params, cos, sin, kc, vc, sampler, last_logits, lengths,
                    tokens, length, slot, row, counts_row):
             """Prefill one request into `slot` + install its sampler row."""
@@ -178,21 +215,36 @@ class Engine:
             )
             last_logits = last_logits.at[slot].set(logits[0])
             lengths = lengths.at[slot].set(length)
-            new_fields = {}
-            for f in dataclasses.fields(SamplerState):
-                cur = getattr(sampler, f.name)
-                if f.name == "token_counts":
-                    new_fields[f.name] = cur.at[slot].set(counts_row)
-                else:
-                    new_fields[f.name] = cur.at[slot].set(row[f.name])
-            return kc, vc, SamplerState(**new_fields), last_logits, lengths
+            sampler = _install_row(sampler, slot, row, counts_row)
+            return kc, vc, sampler, last_logits, lengths
+
+        def _extend_mid(params, cos, sin, kc, vc, tokens, start, slot):
+            """One non-final prefill chunk: KV writes only."""
+            _, kc, vc = extend(params, cfg, tokens, start[None], cos, sin,
+                               kc, vc, slot_map=slot[None], with_logits=False)
+            return kc, vc
+
+        def _extend_final(params, cos, sin, kc, vc, sampler, last_logits,
+                          lengths, tokens, start, nvalid, slot, row,
+                          counts_row):
+            """Final prefill chunk: KV writes + last-token logits + sampler
+            row install (deferred to here so the request's RNG stream is
+            independent of how many engine ticks the prefill spanned)."""
+            logits, kc, vc = extend(
+                params, cfg, tokens, start[None], cos, sin, kc, vc,
+                slot_map=slot[None],
+                last_pos=jnp.maximum(nvalid - 1, 0)[None])
+            last_logits = last_logits.at[slot].set(logits[0])
+            lengths = lengths.at[slot].set(start + nvalid)
+            sampler = _install_row(sampler, slot, row, counts_row)
+            return kc, vc, sampler, last_logits, lengths
 
         def _decode(params, cos, sin, kc, vc, sampler, last_logits, lengths,
                     active, mask_bits):
             """sample(prev logits) → decode → next logits, for all slots."""
             tokens, keys, logprobs = sample(last_logits, sampler, mask_bits)
             logits, kc, vc = decode_step(
-                params, cfg, tokens, lengths, cos, sin, kc, vc
+                params, cfg, tokens, lengths, cos, sin, kc, vc, active
             )
             act = active.astype(jnp.int32)
             counts = sampler.token_counts.at[
@@ -208,6 +260,9 @@ class Engine:
         # mask_bits=None compiles a no-grammar variant with zero extra
         # host→device traffic on the common path.
         self._admit_fn = jax.jit(_admit, donate_argnums=(3, 4, 5, 6, 7))
+        self._extend_mid_fn = jax.jit(_extend_mid, donate_argnums=(3, 4))
+        self._extend_final_fn = jax.jit(_extend_final,
+                                        donate_argnums=(3, 4, 5, 6, 7))
         self._decode_fn = jax.jit(_decode, donate_argnums=(3, 4, 5, 6, 7),
                                   static_argnames=())
         self._decode_nomask_fn = jax.jit(
@@ -221,10 +276,11 @@ class Engine:
             raise RuntimeError("engine loop has terminated; no new requests")
         if len(req.prompt_ids) == 0:
             raise ValueError("empty prompt")
-        if len(req.prompt_ids) > max(self.ec.prefill_buckets):
+        if len(req.prompt_ids) > self.ec.max_context - 2:
             raise ValueError(
-                f"prompt length {len(req.prompt_ids)} exceeds max prefill "
-                f"bucket {max(self.ec.prefill_buckets)}"
+                f"prompt length {len(req.prompt_ids)} exceeds max_context-2 "
+                f"({self.ec.max_context - 2}); longer prompts need a larger "
+                f"context window"
             )
         V = self.cfg.vocab_size
         if any(not (0 <= t < V) for t in req.prompt_ids):
@@ -245,10 +301,10 @@ class Engine:
     # ------------------------------------------------------------ the loop
 
     def _bucket(self, n: int) -> int:
-        for b in self.ec.prefill_buckets:
+        for b in self._small_buckets:
             if n <= b:
                 return b
-        raise ValueError(f"prompt too long: {n}")
+        raise ValueError(f"prompt too long for single-shot prefill: {n}")
 
     def _compile_grammar(self, grammar: str):
         """Compile (or fetch cached) GBNF → CompiledGrammar. Called from gRPC
@@ -267,15 +323,16 @@ class Engine:
         return self._compile_grammar(grammar).state()
 
     def _admit_one(self, rid: int, req: GenRequest, out: queue.Queue) -> bool:
-        # Host-side per-request failures (bad GBNF, missing tokenizer, prompt
-        # too long) must reject THIS request only — never kill the loop, which
-        # would strand every other in-flight stream (the reference rejects a
-        # bad grammar per-request in the sampler). Device failures below are
-        # engine-fatal on purpose: donation makes the state unrecoverable.
+        # Host-side per-request failures (bad GBNF, missing tokenizer) must
+        # reject THIS request only — never kill the loop, which would strand
+        # every other in-flight stream (the reference rejects a bad grammar
+        # per-request in the sampler). Device failures below are engine-fatal
+        # on purpose: donation makes the state unrecoverable.
         try:
             matcher = self._matcher_for(req.grammar) if req.grammar else None
             n = len(req.prompt_ids)
-            bucket = self._bucket(n)
+            chunked = n > self._small_max
+            bucket = None if chunked else self._bucket(n)
         except Exception:
             out.put(StepOutput(
                 request_id=rid, text="", token_id=-1,
@@ -284,29 +341,33 @@ class Engine:
             ))
             return False
         slot = self._free.pop()
-        ids = np.zeros((1, bucket), np.int32)
-        ids[0, :n] = req.prompt_ids
         counts_row = np.zeros((self.cfg.vocab_size,), np.int32)
         pid, pcnt = np.unique(np.asarray(req.prompt_ids, np.int64), return_counts=True)
         counts_row[pid] = pcnt
         row = sampler_row(req.params, self.cfg.vocab_size, fallback_seed=rid + 1)
 
-        with activate_mesh(self.mesh):
-            (self._kc, self._vc, self._sampler, self._last_logits,
-             self._lengths) = self._admit_fn(
-                self.params, self._cos, self._sin,
-                self._kc, self._vc, self._sampler, self._last_logits,
-                self._lengths,
-                jnp.asarray(ids), jnp.int32(n), jnp.int32(slot),
-                row, jnp.asarray(counts_row),
-            )
+        if not chunked:
+            ids = np.zeros((1, bucket), np.int32)
+            ids[0, :n] = req.prompt_ids
+            with activate_mesh(self.mesh):
+                (self._kc, self._vc, self._sampler, self._last_logits,
+                 self._lengths) = self._admit_fn(
+                    self.params, self._cos, self._sin,
+                    self._kc, self._vc, self._sampler, self._last_logits,
+                    self._lengths,
+                    jnp.asarray(ids), jnp.int32(n), jnp.int32(slot),
+                    row, jnp.asarray(counts_row),
+                )
 
         self._slots[slot] = _Slot(
             request_id=rid, req=req, out=out,
             detok=self.tok.stream_decoder() if self.tok else None,
             matcher=matcher,
             start_time=time.monotonic(), prompt_len=n,
+            prefilled=not chunked, row=row, counts_row=counts_row,
         )
+        if chunked:
+            self._prefillq.append(slot)
         if matcher is not None:
             eos = self.tok.eos_ids if self.tok else ()
             self._mask_host[slot] = matcher.mask_bits(eos)
@@ -314,24 +375,60 @@ class Engine:
         self.metrics["prompt_tokens_processed"] += n
         return True
 
+    def _prefill_tick(self):
+        """One unit of admission work per engine tick: either continue the
+        oldest in-progress chunked prefill by ONE chunk, or admit one queued
+        request. Bounding this to one chunk keeps running decodes at a steady
+        cadence instead of stalling behind whole long prompts (the reference's
+        update_slots interleaving, grpc-server.cpp:69-97)."""
+        if self._prefillq:
+            idx = self._prefillq[0]
+            slot = self._slots[idx]
+            ids = slot.req.prompt_ids
+            pos = slot.prefill_pos
+            nvalid = min(len(ids) - pos, self._chunk)
+            buf = np.zeros((1, self._chunk), np.int32)
+            buf[0, :nvalid] = ids[pos:pos + nvalid]
+            final = pos + nvalid == len(ids)
+            with activate_mesh(self.mesh):
+                if final:
+                    (self._kc, self._vc, self._sampler, self._last_logits,
+                     self._lengths) = self._extend_final_fn(
+                        self.params, self._cos, self._sin,
+                        self._kc, self._vc, self._sampler, self._last_logits,
+                        self._lengths, jnp.asarray(buf), jnp.int32(pos),
+                        jnp.int32(nvalid), jnp.int32(idx), slot.row,
+                        jnp.asarray(slot.counts_row))
+                else:
+                    self._kc, self._vc = self._extend_mid_fn(
+                        self.params, self._cos, self._sin, self._kc, self._vc,
+                        jnp.asarray(buf), jnp.int32(pos), jnp.int32(idx))
+            slot.prefill_pos = pos + nvalid
+            if final:
+                slot.prefilled = True
+                self._prefillq.pop(0)
+            return
+        if not self._free:
+            return
+        try:
+            rid, req, out = self._queue.get_nowait()
+        except queue.Empty:
+            return
+        self._admit_one(rid, req, out)
+
     def _active_mask(self) -> np.ndarray:
-        return np.array([s is not None for s in self._slots], bool)
+        return np.array([s is not None and s.prefilled for s in self._slots],
+                        bool)
 
-    def step(self) -> bool:
-        """One engine iteration: admit waiting work, run one decode step.
-        Returns True if any slot is active after the step."""
-        # admission
-        while self._free:
-            try:
-                rid, req, out = self._queue.get_nowait()
-            except queue.Empty:
-                break
-            self._admit_one(rid, req, out)
-
+    def _dispatch(self):
+        """Dispatch one decode step for the currently-active slots; returns
+        (tokens_dev, logprobs_dev, [(slot_idx, request_id)]) without waiting
+        for the device — or None if nothing is active."""
         active = self._active_mask()
         if not active.any():
-            return False
-
+            return None
+        entries = [(int(i), self._slots[i].request_id)
+                   for i in np.where(active)[0]]
         with activate_mesh(self.mesh):
             args = (self.params, self._cos, self._sin,
                     self._kc, self._vc, self._sampler, self._last_logits,
@@ -344,15 +441,47 @@ class Engine:
                 (tokens, logprobs, self._kc, self._vc, self._sampler,
                  self._last_logits, self._lengths) = self._decode_nomask_fn(
                     *args)
+        return tokens, logprobs, entries
+
+    def _consume(self, pend):
+        """Block on a dispatched step's results and run the host-side token
+        handling for every slot that was active at dispatch time and is still
+        serving the same request."""
+        tokens, logprobs, entries = pend
         tokens = np.asarray(jax.device_get(tokens))
         logprobs = np.asarray(jax.device_get(logprobs))
-
         now = time.monotonic()
-        for i, slot in enumerate(self._slots):
-            if slot is None:
+        for i, rid in entries:
+            slot = self._slots[i]
+            if slot is None or slot.request_id != rid:
                 continue
             self._emit(i, slot, int(tokens[i]), float(logprobs[i]), now)
-        return any(s is not None for s in self._slots)
+
+    def step(self) -> bool:
+        """One engine iteration. In pipelined mode (the default, grammar-free)
+        one decode step stays in flight: step N+1 is dispatched before step
+        N's tokens are pulled to the host, hiding the device→host sync +
+        Python bookkeeping behind the next step's compute. Grammar-constrained
+        batches run synchronously (the sampled token must update the PDA mask
+        before the next sample). Returns True while work remains."""
+        sync = self._grammar_slots > 0 or not self.ec.pipeline
+        if sync and self._pending is not None:
+            self._consume(self._pending)
+            self._pending = None
+        cur = self._dispatch()
+        self._prefill_tick()
+        if cur is None:
+            if self._pending is not None:
+                self._consume(self._pending)
+                self._pending = None
+        elif sync:
+            self._consume(cur)
+        else:
+            prev, self._pending = self._pending, cur
+            if prev is not None:
+                self._consume(prev)
+        return (any(s is not None for s in self._slots)
+                or not self._queue.empty() or self._pending is not None)
 
     def _emit(self, idx: int, slot: _Slot, token_id: int, logprob: float,
               now: float):
@@ -460,6 +589,8 @@ class Engine:
     def _fail_active(self, reason: str):
         """Send a terminal StepOutput to every in-flight slot + queued request
         so no consumer blocks forever on its output queue."""
+        self._pending = None
+        self._prefillq.clear()
         for i, slot in enumerate(self._slots):
             if slot is None:
                 continue

@@ -273,17 +273,24 @@ def prefill(params, cfg: LlamaConfig, tokens, lengths, cos, sin,
 
 
 def decode_step(params, cfg: LlamaConfig, tokens, lengths, cos, sin,
-                k_cache, v_cache):
+                k_cache, v_cache, active=None):
     """One continuous-batching decode step over ALL slots.
 
     tokens: [B] i32 — last sampled token per slot; lengths: [B] — cache entries
     valid per slot BEFORE this token (the new token is written at index
     lengths). Inactive slots just compute garbage that is masked host-side.
+    `active` [B] bool (optional): inactive slots redirect their cache write to
+    the last cache row (never a readable position — the engine terminates at
+    max_context-1) so a decode step can run concurrently with a chunked
+    prefill into an inactive slot without corrupting it.
     Returns (logits [B, V] f32, k_cache, v_cache).
     """
     b = tokens.shape[0]
+    T = k_cache.shape[2]
     _, attn_decode = _attn_impls()
     positions = lengths[:, None]  # [B,1]
+    wpos = positions if active is None else jnp.where(
+        active[:, None], positions, T - 1)
     x = params["embed"].astype(cfg.jdtype)[tokens][:, None, :]  # [B,1,H]
 
     def layer(x, xs):
@@ -292,8 +299,8 @@ def decode_step(params, cfg: LlamaConfig, tokens, lengths, cos, sin,
         q, k, v = _qkv(h, lp, cfg)
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
-        kc = kc.at[jnp.arange(b)[:, None], positions].set(k)
-        vc = vc.at[jnp.arange(b)[:, None], positions].set(v)
+        kc = kc.at[jnp.arange(b)[:, None], wpos].set(k)
+        vc = vc.at[jnp.arange(b)[:, None], wpos].set(v)
         attn = attn_decode(q, kc, vc, lengths + 1,
                            sliding_window=cfg.sliding_window)
         x = x + qmatmul(attn.reshape(b, 1, -1), lp["wo"])
@@ -339,15 +346,24 @@ def hidden_states(params, cfg: LlamaConfig, tokens, lengths=None):
 
 
 def extend(params, cfg: LlamaConfig, tokens, start, cos, sin,
-           k_cache, v_cache):
+           k_cache, v_cache, slot_map=None, with_logits=True, last_pos=None):
     """Forward a window of S tokens per slot starting at cache offset
     `start` [B] — the speculative-decoding verification pass (reference knob:
-    DraftModel/NDraft, /root/reference/backend/backend.proto:218,150). Writes
-    window K/V into the cache and returns logits for EVERY window position
-    [B, S, V] plus the updated caches."""
+    DraftModel/NDraft, /root/reference/backend/backend.proto:218,150) and the
+    chunked-prefill workhorse. Writes window K/V into the cache and returns
+    logits for EVERY window position [B, S, V] plus the updated caches.
+
+    slot_map [B] (optional): which cache slot each batch row reads/writes
+    (defaults to row i ↔ slot i). with_logits=False skips the vocabulary
+    projection (non-final prefill chunks need only the KV writes) and
+    returns (None, k_cache, v_cache). last_pos [B] (optional): project only
+    the hidden state at that window position → logits [B, V], avoiding the
+    [B, S, V] buffer when a single row is wanted (final prefill chunk).
+    """
     from localai_tpu.ops.attention import mha_extend
 
     b, s = tokens.shape
+    rows = jnp.arange(b) if slot_map is None else slot_map
     positions = start[:, None] + jnp.arange(s)[None, :]
     x = params["embed"].astype(cfg.jdtype)[tokens]
 
@@ -357,9 +373,11 @@ def extend(params, cfg: LlamaConfig, tokens, start, cos, sin,
         q, k, v = _qkv(h, lp, cfg)
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
-        kc = kc.at[jnp.arange(b)[:, None], positions].set(k)
-        vc = vc.at[jnp.arange(b)[:, None], positions].set(v)
-        attn = mha_extend(q, kc, vc, positions,
+        kc = kc.at[rows[:, None], positions].set(k)
+        vc = vc.at[rows[:, None], positions].set(v)
+        kr = kc if slot_map is None else kc[rows]
+        vr = vc if slot_map is None else vc[rows]
+        attn = mha_extend(q, kr, vr, positions,
                           sliding_window=cfg.sliding_window)
         x = x + qmatmul(attn.reshape(b, s, -1), lp["wo"])
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
@@ -369,7 +387,12 @@ def extend(params, cfg: LlamaConfig, tokens, start, cos, sin,
     x, (k_cache, v_cache) = jax.lax.scan(
         layer, x, (params["layers"], k_cache, v_cache)
     )
+    if not with_logits:
+        return None, k_cache, v_cache
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    if last_pos is not None:
+        x = jnp.take_along_axis(x, last_pos[:, None, None], axis=1)[:, 0]
+        return _lm_head(x.astype(jnp.float32), params), k_cache, v_cache
     logits = _lm_head(x.astype(jnp.float32), params)
     return logits, k_cache, v_cache
 
