@@ -9,7 +9,8 @@ Design (TPU-first, not a torch translation):
   executed with lax.scan → one compiled layer body, low compile time, and
   XLA pipelines the weight prefetch (HBM→VMEM) across layers.
 - bf16 weights/activations, f32 norms/softmax/logits head.
-- GQA with a slot-contiguous KV cache [L, B, T, KVH, D] carried through scan.
+- GQA with a slot-contiguous, head-major KV cache [L, B, KVH, T, D] carried
+  through scan (trailing (T, D) dims = the Mosaic-legal Pallas tiling).
 - tensor parallelism by GSPMD: param PartitionSpecs (see param_specs) put
   heads/ffn on the `model` mesh axis; activations get with_sharding_constraint
   hints; XLA inserts the all-reduces (the NCCL-free answer to vLLM's
@@ -162,14 +163,28 @@ def max_model_axis(cfg: LlamaConfig, n_devices: int) -> int:
 
 
 def kv_cache_spec():
-    """KV cache [L, B, T, KVH, D]: slots on `data`, kv heads on `model`."""
-    return P(None, "data", None, "model", None)
+    """KV cache [L, B, KVH, T, D]: slots on `data`, kv heads on `model`."""
+    return P(None, "data", "model", None, None)
 
 
 def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int, dtype=None):
+    """Head-major cache [L, B, KVH, T, D] — trailing (T, D) dims are the
+    Mosaic-legal tiling for the Pallas decode kernel, and the decode hot path
+    reads it with zero transposes."""
     dtype = dtype or cfg.jdtype
-    shape = (cfg.num_layers, batch, max_len, cfg.num_kv_heads, cfg.head_dim)
+    shape = (cfg.num_layers, batch, cfg.num_kv_heads, max_len, cfg.head_dim)
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def _cache_write(kc, vc, k, v, rows, positions):
+    """Scatter window K/V [B, S, KVH, D] into head-major caches [B', KVH, T, D]
+    at (rows[b], :, positions[b, s])."""
+    kvh = kc.shape[1]
+    idx = (rows[:, None, None], jnp.arange(kvh)[None, :, None],
+           positions[:, None, :])
+    kc = kc.at[idx].set(k.transpose(0, 2, 1, 3))
+    vc = vc.at[idx].set(v.transpose(0, 2, 1, 3))
+    return kc, vc
 
 
 # ---------------------------------------------------------------- forward
@@ -208,7 +223,7 @@ def _mlp(x, lp):
 _shard_act = constrain
 
 
-def _attn_impls():
+def _attn_impls(cfg: LlamaConfig | None = None):
     """Select attention kernels at trace time: Pallas (fused, online-softmax)
     on single-chip TPU; XLA reference under a mesh (GSPMD shards the einsums)
     or on CPU. LOCALAI_FORCE_PALLAS=1 forces Pallas (interpreter on CPU —
@@ -221,6 +236,17 @@ def _attn_impls():
     block = os.environ.get("LOCALAI_NO_PALLAS") == "1"
     use = force or (not block and jax.default_backend() == "tpu"
                     and current_mesh() is None)
+    if use and not force:
+        # compile-probe this model's head geometry once: if Mosaic rejects
+        # the kernels on this chip, serve on the XLA path instead of dying
+        # inside the jitted step
+        from localai_tpu.ops.pallas import pallas_works
+
+        if cfg is not None:
+            use = pallas_works(cfg.num_heads, cfg.num_kv_heads, cfg.head_dim,
+                               cfg.sliding_window, cfg.jdtype)
+        else:
+            use = pallas_works()
     if use:
         from localai_tpu.ops.pallas import flash_prefill, ragged_decode
 
@@ -240,7 +266,7 @@ def prefill(params, cfg: LlamaConfig, tokens, lengths, cos, sin,
     Returns (last_token_logits [B, V] f32, k_cache, v_cache).
     """
     b, s = tokens.shape
-    attn_prefill, _ = _attn_impls()
+    attn_prefill, _ = _attn_impls(cfg)
     positions = jnp.arange(s)[None, :].repeat(b, 0)
     x = params["embed"].astype(cfg.jdtype)[tokens]
     x = _shard_act(x, P("data", None, None))
@@ -257,8 +283,7 @@ def prefill(params, cfg: LlamaConfig, tokens, lengths, cos, sin,
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         x = x + _mlp(h, lp)
         x = _shard_act(x, P("data", None, None))
-        kc = kc.at[slot_map[:, None], positions].set(k)
-        vc = vc.at[slot_map[:, None], positions].set(v)
+        kc, vc = _cache_write(kc, vc, k, v, slot_map, positions)
         return x, (kc, vc)
 
     x, (k_cache, v_cache) = jax.lax.scan(
@@ -286,8 +311,8 @@ def decode_step(params, cfg: LlamaConfig, tokens, lengths, cos, sin,
     Returns (logits [B, V] f32, k_cache, v_cache).
     """
     b = tokens.shape[0]
-    T = k_cache.shape[2]
-    _, attn_decode = _attn_impls()
+    T = k_cache.shape[3]
+    _, attn_decode = _attn_impls(cfg)
     positions = lengths[:, None]  # [B,1]
     wpos = positions if active is None else jnp.where(
         active[:, None], positions, T - 1)
@@ -299,8 +324,7 @@ def decode_step(params, cfg: LlamaConfig, tokens, lengths, cos, sin,
         q, k, v = _qkv(h, lp, cfg)
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
-        kc = kc.at[jnp.arange(b)[:, None], wpos].set(k)
-        vc = vc.at[jnp.arange(b)[:, None], wpos].set(v)
+        kc, vc = _cache_write(kc, vc, k, v, jnp.arange(b), wpos)
         attn = attn_decode(q, kc, vc, lengths + 1,
                            sliding_window=cfg.sliding_window)
         x = x + qmatmul(attn.reshape(b, 1, -1), lp["wo"])
@@ -324,7 +348,7 @@ def hidden_states(params, cfg: LlamaConfig, tokens, lengths=None):
     positions = jnp.arange(s)[None, :].repeat(b, 0)
     if lengths is None:
         lengths = jnp.full((b,), s, jnp.int32)
-    attn_prefill, _ = _attn_impls()
+    attn_prefill, _ = _attn_impls(cfg)
     x = params["embed"].astype(cfg.jdtype)[tokens]
     x = _shard_act(x, P("data", None, None))
 
@@ -373,8 +397,7 @@ def extend(params, cfg: LlamaConfig, tokens, start, cos, sin,
         q, k, v = _qkv(h, lp, cfg)
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
-        kc = kc.at[rows[:, None], positions].set(k)
-        vc = vc.at[rows[:, None], positions].set(v)
+        kc, vc = _cache_write(kc, vc, k, v, rows, positions)
         kr = kc if slot_map is None else kc[rows]
         vr = vc if slot_map is None else vc[rows]
         attn = mha_extend(q, kr, vr, positions,

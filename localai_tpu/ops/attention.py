@@ -5,7 +5,10 @@ sharding layer can shard the head axis over the `model` mesh axis):
 
   q:        [B, S, H, D]
   k/v:      [B, S, KVH, D]      (GQA: H % KVH == 0)
-  kv cache: [B, T, KVH, D]      (slot-contiguous cache, T = max context)
+  kv cache: [B, KVH, T, D]      (slot-contiguous, head-major, T = max context —
+                                 head-major keeps the Pallas decode kernel's
+                                 trailing block dims at (seq, head_dim), the
+                                 Mosaic-legal tiling)
 
 Softmax is computed in float32; matmuls stay in the input dtype (bf16).
 These XLA versions are the semantic reference and the CPU-mesh test path;
@@ -64,17 +67,17 @@ def mha_extend(q, k_cache, v_cache, q_positions, *, scale=None,
     """Window attention against the cache: scores S new tokens whose K/V are
     already written at `q_positions` (speculative-verification forward).
 
-    q: [B, S, H, D]; caches: [B, T, KVH, D]; q_positions: [B, S] global
+    q: [B, S, H, D]; caches: [B, KVH, T, D]; q_positions: [B, S] global
     positions of the window tokens. Each query attends to every cache entry
     at position <= its own. Returns [B, S, H, D].
     """
     b, s, h, d = q.shape
-    t = k_cache.shape[1]
-    kvh = k_cache.shape[2]
+    kvh = k_cache.shape[1]
+    t = k_cache.shape[2]
     scale = scale if scale is not None else d ** -0.5
 
     qg = _group_query_heads(q, kvh)                             # [B,S,KVH,G,D]
-    logits = jnp.einsum("bskgd,btkd->bkgst", qg, k_cache).astype(jnp.float32) * scale
+    logits = jnp.einsum("bskgd,bktd->bkgst", qg, k_cache).astype(jnp.float32) * scale
 
     pos = jnp.arange(t)
     mask = pos[None, None, :] <= q_positions[:, :, None]        # [B,S,T]
@@ -84,7 +87,7 @@ def mha_extend(q, k_cache, v_cache, q_positions, *, scale=None,
     logits = jnp.where(mask[:, None, None, :, :], logits, NEG_INF)
 
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    out = jnp.einsum("bkgst,btkd->bskgd", probs, v_cache)
+    out = jnp.einsum("bkgst,bktd->bskgd", probs, v_cache)
     return out.reshape(b, s, h, d)
 
 
@@ -92,17 +95,17 @@ def mha_decode(q, k_cache, v_cache, lengths, *, scale=None, softcap=None,
                sliding_window=None):
     """Single-token decode attention against a slot-contiguous KV cache.
 
-    q: [B, 1, H, D]; k_cache/v_cache: [B, T, KVH, D]; lengths: [B] — number of
+    q: [B, 1, H, D]; k_cache/v_cache: [B, KVH, T, D]; lengths: [B] — number of
     valid cache entries per slot INCLUDING the token being decoded.
     Returns [B, 1, H, D].
     """
     b, _, h, d = q.shape
-    t = k_cache.shape[1]
-    kvh = k_cache.shape[2]
+    kvh = k_cache.shape[1]
+    t = k_cache.shape[2]
     scale = scale if scale is not None else d ** -0.5
 
     qg = _group_query_heads(q, kvh)[:, 0]                       # [B,KVH,G,D]
-    logits = jnp.einsum("bkgd,btkd->bkgt", qg, k_cache).astype(jnp.float32) * scale
+    logits = jnp.einsum("bkgd,bktd->bkgt", qg, k_cache).astype(jnp.float32) * scale
     logits = _softcap(logits, softcap)
 
     pos = jnp.arange(t)
@@ -112,5 +115,5 @@ def mha_decode(q, k_cache, v_cache, lengths, *, scale=None, softcap=None,
     logits = jnp.where(mask[:, None, None, :], logits, NEG_INF)
 
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    out = jnp.einsum("bkgt,btkd->bkgd", probs, v_cache)
+    out = jnp.einsum("bkgt,bktd->bkgd", probs, v_cache)
     return out.reshape(b, 1, h, d)

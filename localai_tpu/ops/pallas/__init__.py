@@ -2,4 +2,5 @@ from localai_tpu.ops.pallas.flash_attention import (  # noqa: F401
     flash_prefill,
     ragged_decode,
     pallas_available,
+    pallas_works,
 )

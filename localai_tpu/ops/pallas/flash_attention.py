@@ -10,11 +10,21 @@ Two kernels:
 - flash_prefill: causal GQA attention over padded prompt batches
   [B, S, H, D]; per-row validity from `lengths`; optional sliding window.
 - ragged_decode: one-token-per-slot decode attention against the slot KV
-  cache [B, T, KVH, D]; each (slot, head) program scans only
-  ceil(length/BLOCK) KV blocks — the "ragged" part that makes long-context
-  decode O(valid tokens), not O(max context).
+  cache [B, KVH, T, D]; the KV-block axis lives in the GRID with a
+  scalar-prefetched index map that clamps out-of-range blocks to the last
+  valid one — Mosaic skips the DMA when consecutive grid steps map to the
+  same block, so each slot streams only ceil(length/BLOCK) KV blocks from
+  HBM. That is the "ragged" part: long-context decode is O(valid tokens) in
+  both compute AND memory traffic, not O(max context).
 
-On CPU (tests) both run in interpreter mode; the math is identical.
+Mosaic tiling rule (the round-3 lesson): the LAST TWO dims of every block
+shape must be (divisible by 8, divisible by 128) or equal to the array dims.
+Heads therefore live in the grid, never in a trailing block dim; every block
+is [..., seq_block, head_dim] over head-major [B, H, S, D] layouts.
+
+On CPU (tests) both run in interpreter mode; the math is identical. Real-TPU
+lowering is validated by tests/test_tpu_real.py (TPU-gated) and by the
+pallas_works() probe the model uses before selecting this path.
 """
 from __future__ import annotations
 
@@ -43,19 +53,19 @@ def _interpret() -> bool:
 def _prefill_kernel(lengths_ref, q_ref, k_ref, v_ref, o_ref, *,
                     block_q: int, block_k: int, scale: float,
                     sliding_window: int | None):
+    b = pl.program_id(0)
     qb = pl.program_id(2)
-    length = lengths_ref[0]
-    q = q_ref[0, :, 0, :].astype(jnp.float32) * scale          # [BQ, D]
-    S = k_ref.shape[1]
-    num_kb = pl.cdiv(S, block_k)
+    length = lengths_ref[b]
+    q = q_ref[0, 0].astype(jnp.float32) * scale                # [BQ, D]
+    S = k_ref.shape[2]
 
     q_pos = qb * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
 
     def body(kb, carry):
         m, l, acc = carry
-        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), 0, :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), 0, :].astype(jnp.float32)
+        k_blk = k_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)  # [BQ, BK]
         k_pos = kb * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
@@ -63,25 +73,26 @@ def _prefill_kernel(lengths_ref, q_ref, k_ref, v_ref, o_ref, *,
         if sliding_window is not None:
             mask &= k_pos > q_pos - sliding_window
         s = jnp.where(mask, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[:, None])
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))   # [BQ,1]
+        p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=-1)
-        acc_new = acc * alpha[:, None] + jnp.dot(
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.dot(
             p, v_blk, preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
+    num_kb = pl.cdiv(S, block_k)
     # causal: only KV blocks up to (and including) this query block
     last_kb = jnp.minimum(
         (qb + 1) * block_q + block_k - 1, S + block_k - 1) // block_k
     last_kb = jnp.minimum(last_kb, num_kb)
-    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
     acc0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
     m, l, acc = jax.lax.fori_loop(0, last_kb, body, (m0, l0, acc0))
 
-    out = acc / jnp.maximum(l, 1e-30)[:, None]
-    o_ref[0, :, 0, :] = out.astype(o_ref.dtype)
+    out = acc / jnp.maximum(l, 1e-30)
+    o_ref[0, 0] = out.astype(o_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("sliding_window", "block_q",
@@ -97,97 +108,198 @@ def flash_prefill(q, k, v, lengths, sliding_window=None,
     block_k = min(block_k, S)
     scale = D ** -0.5
 
+    # pad K/V so block_k divides the KV length: pl.ds CLAMPS an out-of-range
+    # start (it does not pad), which would silently misattribute key positions
+    # in the final partial block. Zero padding is masked out by k_pos<length.
+    Sk = pl.cdiv(S, block_k) * block_k
+    if Sk != S:
+        pad = [(0, 0), (0, Sk - S), (0, 0), (0, 0)]
+        k = jnp.pad(k, pad)
+        v = jnp.pad(v, pad)
+
+    # head-major layouts so trailing block dims are (seq, head_dim)
+    qt = q.transpose(0, 2, 1, 3)                               # [B, H, S, D]
+    kt = k.transpose(0, 2, 1, 3)                               # [B, KVH, Sk, D]
+    vt = v.transpose(0, 2, 1, 3)
+
     grid = (B, H, pl.cdiv(S, block_q))
     kernel = functools.partial(
         _prefill_kernel, block_q=block_q, block_k=block_k, scale=scale,
         sliding_window=sliding_window)
-    return pl.pallas_call(
+    out = pl.pallas_call(
         kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1,), lambda b, h, qb: (b,),
-                         memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, block_q, 1, D),
-                         lambda b, h, qb: (b, qb, h, 0)),
-            pl.BlockSpec((1, S, 1, D),
-                         lambda b, h, qb: (b, 0, h // group, 0)),
-            pl.BlockSpec((1, S, 1, D),
-                         lambda b, h, qb: (b, 0, h // group, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, 1, D),
-                               lambda b, h, qb: (b, qb, h, 0)),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1, block_q, D),
+                             lambda b, h, qb, lens: (b, h, qb, 0)),
+                pl.BlockSpec((1, 1, Sk, D),
+                             lambda b, h, qb, lens: (b, h // group, 0, 0)),
+                pl.BlockSpec((1, 1, Sk, D),
+                             lambda b, h, qb, lens: (b, h // group, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, block_q, D),
+                                   lambda b, h, qb, lens: (b, h, qb, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
-    )(lengths.astype(jnp.int32), q, k, v)
+    )(lengths.astype(jnp.int32), qt, kt, vt)
+    return out.transpose(0, 2, 1, 3)
 
 
 # --------------------------------------------------------------- decode
 
-def _decode_kernel(lengths_ref, q_ref, k_ref, v_ref, o_ref, *,
-                   block_k: int, scale: float, sliding_window: int | None):
-    length = lengths_ref[0]
-    q = q_ref[0, 0, 0, :, :].astype(jnp.float32) * scale        # [G, D]
-    T = k_ref.shape[1]
+def _decode_kernel(lengths_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_ref, l_ref, acc_ref, *,
+                   block_k: int, num_kb: int, t_total: int, scale: float,
+                   sliding_window: int | None):
+    b = pl.program_id(0)
+    kb = pl.program_id(2)
+    length = lengths_ref[b]
 
-    def body(kb, carry):
-        m, l, acc = carry
-        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), 0, :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), 0, :].astype(jnp.float32)
+    @pl.when(kb == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    start = kb * block_k
+    live = start < length
+    if sliding_window is not None:
+        live &= (start + block_k) > (length - sliding_window)
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * scale            # [G, D]
+        k_blk = k_ref[0, 0].astype(jnp.float32)                # [BK, D]
+        v_blk = v_ref[0, 0].astype(jnp.float32)
+        if t_total % block_k:
+            # final partial block: rows past the array end hold UNDEFINED
+            # values (NaN in interpret mode) — zero them so 0·undef can't
+            # poison the accumulator through the p@v matmul
+            row_pos = start + jax.lax.broadcasted_iota(
+                jnp.int32, (k_blk.shape[0], 1), 0)
+            v_blk = jnp.where(row_pos < t_total, v_blk, 0.0)
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)  # [G, BK]
-        k_pos = kb * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (q.shape[0], block_k), 1)
-        mask = k_pos < length
+        k_pos = start + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        mask = k_pos < jnp.minimum(length, t_total)
         if sliding_window is not None:
-            mask &= k_pos > length - 1 - sliding_window
+            mask &= k_pos >= length - sliding_window
         s = jnp.where(mask, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[:, None])
-        alpha = jnp.exp(m - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=-1)
-        acc_new = acc * alpha[:, None] + jnp.dot(
+        # m/l live lane-replicated in [G, 128] scratch
+        m_prev = m_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new[:, :1])
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha[:, :1] + jnp.dot(
             p, v_blk, preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
+        m_ref[...] = m_new
 
-    # ragged: scan only the blocks holding valid cache entries
-    num_kb = jnp.minimum(pl.cdiv(length, block_k), pl.cdiv(T, block_k))
-    G = q.shape[0]
-    m0 = jnp.full((G,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((G,), jnp.float32)
-    acc0 = jnp.zeros((G, q.shape[-1]), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
-    out = acc / jnp.maximum(l, 1e-30)[:, None]
-    o_ref[0, 0, 0, :, :] = out.astype(o_ref.dtype)
+    @pl.when(kb == num_kb - 1)
+    def _finish():
+        out = acc_ref[...] / jnp.maximum(l_ref[...][:, :1], 1e-30)
+        o_ref[0, 0] = out.astype(o_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("sliding_window", "block_k"))
 def ragged_decode(q, k_cache, v_cache, lengths, sliding_window=None,
                   block_k: int = 256):
-    """Decode-step GQA attention. q: [B, 1, H, D]; caches [B, T, KVH, D];
+    """Decode-step GQA attention. q: [B, 1, H, D]; caches [B, KVH, T, D];
     lengths: [B] valid entries incl. the newly-written token.
     Returns [B, 1, H, D]."""
     B, _, H, D = q.shape
-    T, KVH = k_cache.shape[1], k_cache.shape[2]
+    KVH, T = k_cache.shape[1], k_cache.shape[2]
     group = H // KVH
     block_k = min(block_k, T)
+    num_kb = pl.cdiv(T, block_k)
     scale = D ** -0.5
 
-    # one program per (slot, kv head): its q block is the GQA group
-    qg = q.reshape(B, 1, KVH, group, D)
-    kernel = functools.partial(_decode_kernel, block_k=block_k, scale=scale,
+    # one (slot, kv head) pair per grid row; its q block is the GQA group
+    qg = q.reshape(B, KVH, group, D)
+
+    def kv_map(b, h, kb, lens):
+        # clamp beyond-length blocks to the last valid one: Mosaic skips the
+        # DMA when the block index repeats, making traffic O(length)
+        last = jnp.maximum(pl.cdiv(lens[b], block_k) - 1, 0)
+        return (b, h, jnp.minimum(kb, last), 0)
+
+    kernel = functools.partial(_decode_kernel, block_k=block_k,
+                               num_kb=num_kb, t_total=T, scale=scale,
                                sliding_window=sliding_window)
     out = pl.pallas_call(
         kernel,
-        grid=(B, KVH),
-        in_specs=[
-            pl.BlockSpec((1,), lambda b, h: (b,),
-                         memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 1, 1, group, D), lambda b, h: (b, 0, h, 0, 0)),
-            pl.BlockSpec((1, T, 1, D), lambda b, h: (b, 0, h, 0)),
-            pl.BlockSpec((1, T, 1, D), lambda b, h: (b, 0, h, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, 1, 1, group, D),
-                               lambda b, h: (b, 0, h, 0, 0)),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B, KVH, num_kb),
+            in_specs=[
+                pl.BlockSpec((1, 1, group, D),
+                             lambda b, h, kb, lens: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, block_k, D), kv_map),
+                pl.BlockSpec((1, 1, block_k, D), kv_map),
+            ],
+            out_specs=pl.BlockSpec((1, 1, group, D),
+                                   lambda b, h, kb, lens: (b, h, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((group, 128), jnp.float32),   # m (lane-replicated)
+                pltpu.VMEM((group, 128), jnp.float32),   # l
+                pltpu.VMEM((group, D), jnp.float32),     # acc
+            ],
+        ),
         out_shape=jax.ShapeDtypeStruct(qg.shape, q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(lengths.astype(jnp.int32), qg, k_cache, v_cache)
     return out.reshape(B, 1, H, D)
+
+
+# --------------------------------------------------------------- probe
+
+_PROBE_CACHE: dict[tuple, bool] = {}
+
+
+def pallas_works(num_heads: int = 4, num_kv_heads: int = 2,
+                 head_dim: int = 128, sliding_window: int | None = None,
+                 dtype=jnp.bfloat16) -> bool:
+    """Compile-probe the kernels once per (shape, dtype) on this backend.
+
+    Round-3 failure mode: the kernels lowered fine in interpreter mode but
+    Mosaic rejected them on the real chip — killing the serving engine from
+    inside the jitted step. Mosaic's tiling legality is SHAPE-dependent, so
+    the probe uses the caller's head geometry (the model passes its config),
+    letting the attention selector fall back to the XLA path instead of dying.
+    """
+    key = (num_heads, num_kv_heads, head_dim, sliding_window,
+           jnp.dtype(dtype).name)
+    if key in _PROBE_CACHE:
+        return _PROBE_CACHE[key]
+    if jax.default_backend() != "tpu":
+        _PROBE_CACHE[key] = True        # interpreter mode: always lowers
+        return True
+    try:
+        B, S, T = 1, 256, 512
+        q = jnp.zeros((B, S, num_heads, head_dim), dtype)
+        kv = jnp.zeros((B, S, num_kv_heads, head_dim), dtype)
+        lengths = jnp.array([S], jnp.int32)
+        flash_prefill(q, kv, kv, lengths,
+                      sliding_window=sliding_window).block_until_ready()
+        qd = jnp.zeros((B, 1, num_heads, head_dim), dtype)
+        cache = jnp.zeros((B, num_kv_heads, T, head_dim), dtype)
+        ragged_decode(qd, cache, cache, lengths,
+                      sliding_window=sliding_window).block_until_ready()
+        ok = True
+    except Exception as e:      # pragma: no cover - TPU-only branch
+        import logging
+
+        logging.getLogger("localai_tpu").warning(
+            "Pallas attention failed to lower on %s for heads=%d kv=%d d=%d "
+            "— falling back to XLA attention: %s",
+            jax.devices()[0].device_kind, num_heads, num_kv_heads, head_dim, e)
+        ok = False
+    _PROBE_CACHE[key] = ok
+    return ok

@@ -23,12 +23,32 @@ if "xla_force_host_platform_device_count" not in flags:
 import pytest  # noqa: E402
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+# LOCALAI_TPU_TESTS=1 runs the suite on the real accelerator instead (the
+# TPU-gated tests in test_tpu_real.py only execute in that mode; the driver
+# uses this to validate real-chip lowering, the round-3 gap). Mesh-dependent
+# tests need 8 devices — on smaller TPU hosts only the real-TPU tests run.
+_REAL = os.environ.get("LOCALAI_TPU_TESTS") == "1"
+if not _REAL:
+    jax.config.update("jax_platforms", "cpu")
 # numerics tests compare against f64 numpy references; keep CPU matmuls exact
 jax.config.update("jax_default_matmul_precision", "float32")
 
-assert jax.devices()[0].platform == "cpu", "tests must run on CPU"
-assert len(jax.devices()) == 8, "virtual 8-device mesh required"
+if not _REAL:
+    assert jax.devices()[0].platform == "cpu", "tests must run on CPU"
+    assert len(jax.devices()) == 8, "virtual 8-device mesh required"
+
+
+def pytest_collection_modifyitems(config, items):
+    """Real-accelerator mode on a host with fewer than 8 devices: only the
+    TPU-gated lowering tests are meaningful — the rest assume the virtual
+    8-device mesh harness."""
+    if not _REAL or len(jax.devices()) >= 8:
+        return
+    skip = pytest.mark.skip(reason="LOCALAI_TPU_TESTS=1 with <8 devices: "
+                                   "only real-TPU lowering tests run")
+    for item in items:
+        if "test_tpu_real" not in str(item.fspath):
+            item.add_marker(skip)
 
 
 @pytest.fixture(scope="session")

@@ -65,8 +65,8 @@ def test_mha_decode_matches_prefill_last_row():
     lengths = jnp.array([S, S])
     pre = mha_prefill(q, k, v, lengths)
     T = 16
-    kc = jnp.zeros((B, T, KVH, D)).at[:, :S].set(k)
-    vc = jnp.zeros((B, T, KVH, D)).at[:, :S].set(v)
+    kc = jnp.zeros((B, KVH, T, D)).at[:, :, :S].set(k.transpose(0, 2, 1, 3))
+    vc = jnp.zeros((B, KVH, T, D)).at[:, :, :S].set(v.transpose(0, 2, 1, 3))
     dec = mha_decode(q[:, S - 1:S], kc, vc, lengths)
     np.testing.assert_allclose(np.asarray(dec[:, 0]), np.asarray(pre[:, S - 1]),
                                rtol=1e-4, atol=1e-5)

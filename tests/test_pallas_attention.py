@@ -45,8 +45,8 @@ def test_flash_prefill_sliding_window():
 def test_ragged_decode_matches_reference(H, KVH):
     B, T, D = 3, 64, 16
     q = _rand(6, (B, 1, H, D))
-    kc = _rand(7, (B, T, KVH, D))
-    vc = _rand(8, (B, T, KVH, D))
+    kc = _rand(7, (B, KVH, T, D))
+    vc = _rand(8, (B, KVH, T, D))
     lengths = jnp.array([5, 64, 23], jnp.int32)
     ref = mha_decode(q, kc, vc, lengths)
     out = ragged_decode(q, kc, vc, lengths, block_k=16)
@@ -57,13 +57,41 @@ def test_ragged_decode_matches_reference(H, KVH):
 def test_ragged_decode_sliding_window():
     B, T, H, D = 2, 32, 2, 8
     q = _rand(9, (B, 1, H, D))
-    kc = _rand(10, (B, T, H, D))
-    vc = _rand(11, (B, T, H, D))
+    kc = _rand(10, (B, H, T, D))
+    vc = _rand(11, (B, H, T, D))
     lengths = jnp.array([30, 12], jnp.int32)
     ref = mha_decode(q, kc, vc, lengths, sliding_window=8)
     out = ragged_decode(q, kc, vc, lengths, sliding_window=8, block_k=8)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_flash_prefill_partial_blocks():
+    """S not a multiple of block_k: pl.ds clamps, so the kernel must pad K/V
+    (round-4 review finding — silently wrong keys in the final block)."""
+    B, S, H, D = 1, 192, 4, 16
+    q, k, v = _rand(20, (B, S, H, D)), _rand(21, (B, S, H, D)), _rand(22, (B, S, H, D))
+    lengths = jnp.array([137], jnp.int32)
+    ref = mha_prefill(q, k, v, lengths)
+    out = flash_prefill(q, k, v, lengths, block_q=128, block_k=128)
+    np.testing.assert_allclose(np.asarray(out[0, :137]), np.asarray(ref[0, :137]),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ragged_decode_partial_final_block():
+    """T not a multiple of block_k: padded tail rows are undefined and must
+    not poison the accumulator (round-4 review finding — NaN logits)."""
+    B, T, H, KVH, D = 2, 40, 4, 2, 16
+    q = _rand(23, (B, 1, H, D))
+    kc = _rand(24, (B, KVH, T, D))
+    vc = _rand(25, (B, KVH, T, D))
+    for lens in ([40, 7], [39, 16], [33, 40]):
+        lengths = jnp.array(lens, jnp.int32)
+        ref = mha_decode(q, kc, vc, lengths)
+        out = ragged_decode(q, kc, vc, lengths, block_k=16)
+        assert np.isfinite(np.asarray(out)).all()
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
 
 
 def test_model_end_to_end_with_pallas(monkeypatch):

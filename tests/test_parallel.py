@@ -66,9 +66,9 @@ def test_tp_prefill_decode_matches_unsharded(mesh8):
 
     cos, sin = rope_table(CFG.rope, T)
     kv_sh = NamedSharding(mesh8, kv_cache_spec())
-    kc = jax.device_put(jnp.zeros((CFG.num_layers, slots, T, CFG.num_kv_heads,
-                                   CFG.head_dim), CFG.jdtype), kv_sh)
-    vc = jax.device_put(jnp.zeros_like(kc), kv_sh)
+    kc0, vc0 = init_kv_cache(CFG, slots, T)
+    kc = jax.device_put(kc0, kv_sh)
+    vc = jax.device_put(vc0, kv_sh)
 
     with activate_mesh(mesh8):
         pf = jax.jit(partial(prefill, cfg=CFG))
