@@ -5,6 +5,7 @@ parse.go result parsing; wiring in core/http/endpoints/openai/chat.go:224-312).
 from __future__ import annotations
 
 import json
+import uuid
 from typing import Any
 
 from localai_tpu.functions.grammars import JSON_GRAMMAR, json_schema_grammar
@@ -64,12 +65,12 @@ def parse_tool_calls(text: str) -> list[dict[str, Any]] | None:
         return None
     objs = obj if isinstance(obj, list) else [obj]
     calls = []
-    for i, o in enumerate(objs):
+    for o in objs:
         if not isinstance(o, dict) or "name" not in o:
             return None
         args = o.get("arguments", o.get("parameters", {}))
         calls.append({
-            "id": f"call_{i}",
+            "id": f"call_{uuid.uuid4().hex[:12]}",
             "type": "function",
             "function": {
                 "name": o["name"],

@@ -274,26 +274,45 @@ class API:
 
             opts["prompt"] = evaluate_chat(cfg, messages)
 
+        # response_format wins over tools in grammar_for_request — the output
+        # is then the USER's structured format, never a tool call
+        tools_active = (bool(body.get("tools"))
+                        and body.get("tool_choice") != "none"
+                        and not body.get("response_format"))
         handle.mark_busy()
         try:
             if body.get("stream"):
-                return await self._chat_stream(request, cfg, handle, opts)
+                return await self._chat_stream(request, cfg, handle, opts,
+                                               tools_active=tools_active,
+                                               body=body)
             reply = await asyncio.to_thread(
                 lambda: handle.client.predict(**opts))
+            text = reply.message.decode("utf-8", "replace")
+            tool_calls = None
+            if tools_active:
+                # grammar-constrained output → OpenAI tool_calls
+                # (reference: pkg/functions/parse.go wired at chat.go:266-312)
+                from localai_tpu.functions import parse_tool_calls
+
+                tool_calls = parse_tool_calls(text)
             resp = schema.chat_completion(
-                cfg.name, reply.message.decode("utf-8", "replace"),
+                cfg.name, text,
                 reply.finish_reason, reply.prompt_tokens, reply.tokens,
                 timings={
                     "prompt_processing_s": reply.timing_prompt_processing,
                     "token_generation_s": reply.timing_token_generation,
-                })
+                },
+                tool_calls=tool_calls)
             return web.json_response(resp)
         finally:
             handle.mark_idle()
 
-    async def _chat_stream(self, request, cfg, handle, opts):
+    async def _chat_stream(self, request, cfg, handle, opts,
+                           tools_active: bool = False, body: dict | None = None):
         """SSE loop (reference chat.go:334-449): role chunk, deltas, usage
-        chunk, data: [DONE]."""
+        chunk, data: [DONE]. With tools active the output is buffered (it is
+        a grammar-constrained JSON object, meaningless as partial text) and
+        emitted as one tool_calls delta, finish_reason "tool_calls"."""
         resp = web.StreamResponse(headers={
             "Content-Type": "text/event-stream",
             "Cache-Control": "no-cache",
@@ -308,17 +327,34 @@ class API:
         await send(schema.chat_chunk(rid, cfg.name, None, role=True))
         prompt_tokens = completion_tokens = 0
         finish = "stop"
+        buffered: list[str] = []
         async for reply in self._stream_rpc(handle, opts):
             prompt_tokens = reply.prompt_tokens
             completion_tokens = reply.tokens
             text = reply.message.decode("utf-8", "replace")
             if text:
-                await send(schema.chat_chunk(rid, cfg.name, text))
+                if tools_active:
+                    buffered.append(text)
+                else:
+                    await send(schema.chat_chunk(rid, cfg.name, text))
             if reply.finish_reason:
                 finish = reply.finish_reason
+        if tools_active:
+            from localai_tpu.functions import parse_tool_calls
+
+            full = "".join(buffered)
+            calls = parse_tool_calls(full)
+            if calls:
+                await send(schema.chat_chunk(rid, cfg.name, None,
+                                             tool_calls=calls))
+                finish = "tool_calls"
+            elif full:
+                await send(schema.chat_chunk(rid, cfg.name, full))
         await send(schema.chat_chunk(rid, cfg.name, None, finish_reason=finish))
-        if (request.query.get("include_usage")
-                or True):  # usage chunk is cheap and OpenAI-compatible
+        stream_opts = (body or {}).get("stream_options") or {}
+        if stream_opts.get("include_usage", True):
+            # default-on: LocalAI clients expect the usage tail unless the
+            # OpenAI stream_options flag explicitly disables it
             await send(schema.chat_usage_chunk(rid, cfg.name, prompt_tokens,
                                                completion_tokens))
         await resp.write(b"data: [DONE]\n\n")
@@ -712,7 +748,14 @@ class API:
 
 
 def run_server(args) -> int:
-    """CLI `run` entrypoint: assemble config + manager + API and serve."""
+    """CLI `run` entrypoint: assemble config + manager + API and serve
+    (reference: core/application/startup.go + cmd/local-ai/main.go)."""
+    from localai_tpu.core.startup import (
+        ConfigWatcher, load_env_files, preload_models,
+    )
+
+    env_file = getattr(args, "env_file", None)
+    load_env_files([env_file] if env_file else None)
     app_cfg = AppConfig.from_env(
         address=getattr(args, "address", None),
         models_path=getattr(args, "models_path", None),
@@ -739,11 +782,27 @@ def run_server(args) -> int:
         svc.start()
         api.gallery_service = svc
 
+    preload = getattr(args, "models", None) or []
+    if preload:
+        # warm the listed backends in the background so serving starts now
+        # but first requests don't pay the model load (startup.go:65-105)
+        threading.Thread(
+            target=preload_models,
+            args=(list(preload), configs, manager),
+            kwargs={"gallery_service": getattr(api, "gallery_service", None)},
+            daemon=True, name="preload").start()
+
+    watcher = None
+    if not getattr(args, "disable_config_watcher", False):
+        watcher = ConfigWatcher(configs).start()
+
     host, _, port = app_cfg.address.rpartition(":")
     try:
         web.run_app(api.app, host=host or "127.0.0.1", port=int(port),
                     print=lambda *a: print(f"serving on {app_cfg.address}",
                                            flush=True))
     finally:
+        if watcher:
+            watcher.stop()
         manager.stop_all()
     return 0

@@ -21,7 +21,17 @@ def usage(prompt_tokens: int, completion_tokens: int) -> dict:
 
 def chat_completion(model: str, text: str, finish_reason: str,
                     prompt_tokens: int, completion_tokens: int,
-                    timings: dict | None = None) -> dict:
+                    timings: dict | None = None,
+                    tool_calls: list | None = None) -> dict:
+    """OpenAI chat.completion body; with tool_calls the message carries the
+    parsed calls and finish_reason becomes "tool_calls"
+    (reference: core/http/endpoints/openai/chat.go:266-312)."""
+    if tool_calls:
+        message: dict = {"role": "assistant", "content": None,
+                         "tool_calls": tool_calls}
+        finish_reason = "tool_calls"
+    else:
+        message = {"role": "assistant", "content": text}
     out = {
         "id": _id("chatcmpl"),
         "object": "chat.completion",
@@ -29,7 +39,7 @@ def chat_completion(model: str, text: str, finish_reason: str,
         "model": model,
         "choices": [{
             "index": 0,
-            "message": {"role": "assistant", "content": text},
+            "message": message,
             "finish_reason": finish_reason or "stop",
         }],
         "usage": usage(prompt_tokens, completion_tokens),
@@ -40,12 +50,17 @@ def chat_completion(model: str, text: str, finish_reason: str,
 
 
 def chat_chunk(rid: str, model: str, delta_text: str | None,
-               finish_reason: str | None = None, role: bool = False) -> dict:
+               finish_reason: str | None = None, role: bool = False,
+               tool_calls: list | None = None) -> dict:
     delta: dict = {}
     if role:
         delta["role"] = "assistant"
     if delta_text:
         delta["content"] = delta_text
+    if tool_calls:
+        delta["tool_calls"] = [
+            {**c, "index": i} for i, c in enumerate(tool_calls)
+        ]
     return {
         "id": rid,
         "object": "chat.completion.chunk",
