@@ -26,6 +26,10 @@ def _add_run(sub):
     p.add_argument("--parallel-requests", type=int, default=8)
     p.add_argument("--galleries", default=None,
                    help="comma-separated gallery index YAMLs (path or URL)")
+    p.add_argument("--env-file", default=None,
+                   help=".env file to load (default: ./.env, ./.env.local)")
+    p.add_argument("--disable-config-watcher", action="store_true",
+                   help="do not hot-reload model YAMLs on change")
     p.add_argument("--log-level", default="info")
     return p
 

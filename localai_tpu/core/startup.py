@@ -1,0 +1,145 @@
+"""Startup helpers: .env loading, model preload, dynamic config watching.
+
+Reference parity:
+- .env loading — /root/reference/cmd/local-ai/main.go:26-42 (godotenv over
+  .env/.env.local before flag parsing).
+- startup preload — /root/reference/core/application/startup.go:65-105
+  (InstallModels over the CLI positional model list, then warm the backends).
+- dynamic config watcher — /root/reference/core/config/config_file_watcher.go
+  :29-126 (fsnotify on the models dir → hot reload). Here a polling watcher:
+  no inotify dependency, identical observable behavior (new/changed/removed
+  YAML become servable without restart), 2s granularity.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+
+log = logging.getLogger("localai_tpu.startup")
+
+
+def load_env_files(paths: list[str] | None = None) -> list[str]:
+    """Load KEY=VALUE lines from .env files into os.environ (existing vars
+    win, matching godotenv.Load semantics). Returns the files applied."""
+    candidates = paths if paths else [".env", ".env.local"]
+    applied = []
+    for path in candidates:
+        if not path or not os.path.isfile(path):
+            continue
+        try:
+            with open(path, encoding="utf-8") as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line or line.startswith("#") or "=" not in line:
+                        continue
+                    if line.startswith("export "):
+                        line = line[len("export "):]
+                    key, _, value = line.partition("=")
+                    key = key.strip()
+                    value = value.strip()
+                    # quoted values keep their content verbatim; unquoted
+                    # values lose trailing inline comments (godotenv rules)
+                    if len(value) >= 2 and value[0] == value[-1] and \
+                            value[0] in "'\"":
+                        value = value[1:-1]
+                    elif "#" in value:
+                        value = value.split("#", 1)[0].strip()
+                    if key and key not in os.environ:
+                        os.environ[key] = value
+            applied.append(path)
+        except OSError as e:
+            log.warning(".env load failed for %s: %s", path, e)
+    return applied
+
+
+def preload_models(names: list[str], configs, manager,
+                   gallery_service=None, install_timeout: float = 900.0) -> None:
+    """Resolve + warm the CLI's positional model list (startup.go:65-105).
+
+    Each entry is either a configured model name (→ spawn its backend now so
+    the first request doesn't pay the load) or a gallery name/URI (→ install
+    through the gallery service, then warm). Failures log and continue —
+    startup must not die on one bad preload, matching the reference's
+    warn-and-continue loop.
+    """
+    for name in names:
+        cfg = configs.get(name)
+        if cfg is None and gallery_service is not None:
+            try:
+                import time
+
+                job = gallery_service.submit(name)
+                deadline = time.monotonic() + install_timeout
+                while gallery_service.status[job]["state"] in ("queued",
+                                                               "processing"):
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(
+                            f"install still {gallery_service.status[job]['state']} "
+                            f"after {install_timeout:.0f}s")
+                    time.sleep(0.2)
+                if gallery_service.status[job]["state"] == "error":
+                    raise RuntimeError(gallery_service.status[job]["error"])
+                configs.reload()
+                cfg = configs.get(name)
+            except Exception as e:
+                log.warning("preload: install of %r failed: %s", name, e)
+        if cfg is None:
+            log.warning("preload: model %r not found in %s", name,
+                        configs.models_path)
+            continue
+        try:
+            manager.load(cfg)
+            log.info("preload: %s ready", name)
+        except Exception as e:
+            log.warning("preload: backend for %r failed to start: %s", name, e)
+
+
+class ConfigWatcher:
+    """Poll the models dir for YAML add/change/remove → hot reload
+    (config_file_watcher.go role, poll-based)."""
+
+    def __init__(self, configs, interval: float = 2.0):
+        self.configs = configs
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._snapshot = self._scan()
+
+    def _scan(self) -> dict[str, float]:
+        snap: dict[str, float] = {}
+        root = self.configs.models_path
+        try:
+            for entry in os.listdir(root):
+                if entry.endswith((".yaml", ".yml")):
+                    p = os.path.join(root, entry)
+                    try:
+                        snap[entry] = os.stat(p).st_mtime
+                    except OSError:
+                        pass
+        except OSError:
+            pass
+        return snap
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="config-watcher")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=self.interval + 1)
+
+    def _run(self):
+        while not self._stop.wait(self.interval):
+            snap = self._scan()
+            if snap != self._snapshot:
+                self._snapshot = snap
+                try:
+                    self.configs.reload()
+                    log.info("config watcher: models dir changed, reloaded "
+                             "(%d configs)", len(self.configs.names()))
+                except Exception as e:
+                    log.warning("config watcher: reload failed: %s", e)

@@ -1,0 +1,85 @@
+"""Startup helpers: .env loading, preload, config watcher (reference:
+cmd/local-ai/main.go:26-42, core/application/startup.go:65-105,
+core/config/config_file_watcher.go:29-126)."""
+import os
+import time
+
+import yaml
+
+from localai_tpu.core.startup import (
+    ConfigWatcher, load_env_files, preload_models,
+)
+
+
+def test_load_env_files(tmp_path, monkeypatch):
+    envf = tmp_path / ".env"
+    envf.write_text("# comment\nexport FOO_X=1\nBAR_Y='two'\nEXISTING=new\n")
+    monkeypatch.setenv("EXISTING", "old")
+    monkeypatch.delenv("FOO_X", raising=False)
+    monkeypatch.delenv("BAR_Y", raising=False)
+    applied = load_env_files([str(envf)])
+    assert applied == [str(envf)]
+    assert os.environ["FOO_X"] == "1"
+    assert os.environ["BAR_Y"] == "two"
+    assert os.environ["EXISTING"] == "old"  # existing vars win (godotenv)
+    monkeypatch.delenv("FOO_X")
+    monkeypatch.delenv("BAR_Y")
+
+
+def test_load_env_files_missing_ok(tmp_path):
+    assert load_env_files([str(tmp_path / "nope.env")]) == []
+
+
+def test_load_env_inline_comments_and_quotes(tmp_path, monkeypatch):
+    envf = tmp_path / ".env"
+    envf.write_text('PORT_Z=8080 # default\nQUOTED_Z="a # not-comment"\n')
+    monkeypatch.delenv("PORT_Z", raising=False)
+    monkeypatch.delenv("QUOTED_Z", raising=False)
+    load_env_files([str(envf)])
+    assert os.environ["PORT_Z"] == "8080"
+    assert os.environ["QUOTED_Z"] == "a # not-comment"
+    monkeypatch.delenv("PORT_Z")
+    monkeypatch.delenv("QUOTED_Z")
+
+
+class _FakeManager:
+    def __init__(self):
+        self.loaded = []
+
+    def load(self, cfg):
+        self.loaded.append(cfg.name)
+
+
+def test_preload_models(tmp_path):
+    from localai_tpu.config import ModelConfigLoader
+
+    (tmp_path / "m1.yaml").write_text(yaml.safe_dump(
+        {"name": "m1", "backend": "llm"}))
+    configs = ModelConfigLoader(str(tmp_path))
+    mgr = _FakeManager()
+    preload_models(["m1", "missing"], configs, mgr)
+    assert mgr.loaded == ["m1"]  # missing one warns and continues
+
+
+def test_config_watcher_hot_reload(tmp_path):
+    from localai_tpu.config import ModelConfigLoader
+
+    (tmp_path / "a.yaml").write_text(yaml.safe_dump(
+        {"name": "a", "backend": "llm"}))
+    configs = ModelConfigLoader(str(tmp_path))
+    assert configs.names() == ["a"]
+    w = ConfigWatcher(configs, interval=0.1).start()
+    try:
+        (tmp_path / "b.yaml").write_text(yaml.safe_dump(
+            {"name": "b", "backend": "llm"}))
+        deadline = time.time() + 5
+        while time.time() < deadline and "b" not in configs.names():
+            time.sleep(0.05)
+        assert sorted(configs.names()) == ["a", "b"]
+        os.unlink(tmp_path / "a.yaml")
+        deadline = time.time() + 5
+        while time.time() < deadline and "a" in configs.names():
+            time.sleep(0.05)
+        assert configs.names() == ["b"]
+    finally:
+        w.stop()
