@@ -1,10 +1,16 @@
 """Serving benchmark — the measured answer to BASELINE.md (reference publishes
 no numbers; protocol = median of >=5 timed windows after warmup).
 
-Measures the continuous-batching Engine end-to-end on whatever accelerator is
-attached (one TPU chip under the driver; CPU with --cpu for local runs):
-steady-state decode throughput with all slots busy, p50 TTFT through the
-prefill bucket, and MFU derived from the model's FLOPs/token.
+Default mode measures THE SERVING PATH: a real backend subprocess spawned by
+the ModelManager, driven over gRPC PredictStream — the same surface an HTTP
+request rides (BASELINE.md configs #2/#3 ask for the served path, not an
+in-process loop). `--mode engine` keeps the in-process Engine measurement.
+
+The flagship geometry is `8b` (Llama-3.1-8B); bf16 8B does not fit a 16GB
+v5e chip, so 8b defaults to int8 weights (the GGUF-quant-analog path the
+reference's llama.cpp backend also serves with). Checkpoints are synthetic:
+config.json declares the geometry and the loader inits weights on device
+(engine/loader.py _synthetic_params) — measuring compute, not disk.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 vs_baseline is value / 1000 tok/s/chip — the BASELINE.md north star.
@@ -13,49 +19,67 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import statistics
 import sys
+import tempfile
+import threading
 import time
 
 
-def flagship_config(size: str):
-    from localai_tpu.models.llama import LlamaConfig
+SIZES = {
+    # geometry dicts are HF config.json bodies (synthetic checkpoints)
+    "tiny": dict(vocab_size=512, hidden_size=128, intermediate_size=256,
+                 num_hidden_layers=2, num_attention_heads=4,
+                 num_key_value_heads=2, head_dim=32,
+                 max_position_embeddings=512, tie_word_embeddings=True),
+    "1b": dict(vocab_size=128256, hidden_size=2048, intermediate_size=8192,
+               num_hidden_layers=16, num_attention_heads=32,
+               num_key_value_heads=8, head_dim=64,
+               max_position_embeddings=4096, rope_theta=500000.0,
+               tie_word_embeddings=True),
+    "3b": dict(vocab_size=128256, hidden_size=3072, intermediate_size=8192,
+               num_hidden_layers=28, num_attention_heads=24,
+               num_key_value_heads=8, head_dim=128,
+               max_position_embeddings=4096, rope_theta=500000.0,
+               tie_word_embeddings=True),
+    "8b": dict(vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+               num_hidden_layers=32, num_attention_heads=32,
+               num_key_value_heads=8, head_dim=128,
+               max_position_embeddings=8192, rope_theta=500000.0,
+               tie_word_embeddings=False),
+}
 
-    if size == "tiny":  # CPU smoke config
-        return LlamaConfig(vocab_size=512, hidden_size=128,
-                           intermediate_size=256, num_layers=2, num_heads=4,
-                           num_kv_heads=2, head_dim=32, max_position=512,
-                           tie_embeddings=True, dtype="float32")
-    if size == "1b":  # Llama-3.2-1B geometry
-        return LlamaConfig(vocab_size=128256, hidden_size=2048,
-                           intermediate_size=8192, num_layers=16, num_heads=32,
-                           num_kv_heads=8, head_dim=64, max_position=4096,
-                           rope_base=500000.0, tie_embeddings=True,
-                           dtype="bfloat16")
-    if size == "3b":  # Llama-3.2-3B geometry
-        return LlamaConfig(vocab_size=128256, hidden_size=3072,
-                           intermediate_size=8192, num_layers=28, num_heads=24,
-                           num_kv_heads=8, head_dim=128, max_position=4096,
-                           rope_base=500000.0, tie_embeddings=True,
-                           dtype="bfloat16")
-    raise ValueError(size)
+
+def note(msg):
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
 
-def param_count(cfg) -> int:
-    h, i, L, v = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers, cfg.vocab_size
-    qk = cfg.num_heads * cfg.head_dim
-    kv = cfg.num_kv_heads * cfg.head_dim
+def write_synthetic_checkpoint(size: str, path: str) -> str:
+    body = dict(SIZES[size])
+    body.update(architectures=["LlamaForCausalLM"], rms_norm_eps=1e-5,
+                localai_synthetic=True)
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "config.json"), "w") as fh:
+        json.dump(body, fh)
+    return path
+
+
+def param_count(size: str) -> int:
+    g = SIZES[size]
+    h, i = g["hidden_size"], g["intermediate_size"]
+    L, v = g["num_hidden_layers"], g["vocab_size"]
+    hd = g.get("head_dim") or h // g["num_attention_heads"]
+    qk = g["num_attention_heads"] * hd
+    kv = g["num_key_value_heads"] * hd
     per_layer = h * qk + 2 * h * kv + qk * h + 3 * h * i + 2 * h
-    return v * h * (1 if cfg.tie_embeddings else 2) + L * per_layer + h
+    return v * h * (1 if g.get("tie_word_embeddings") else 2) + L * per_layer + h
 
 
-def peak_flops_per_chip() -> float:
+def peak_flops_per_chip(kind: str) -> float:
     """bf16 peak for the attached accelerator (v5e 197 TF/s, v6e 918;
     CPU: nominal 100 GF/s so MFU stays meaningful in smoke runs)."""
-    import jax
-
-    d = jax.devices()[0]
-    kind = getattr(d, "device_kind", "cpu").lower()
+    kind = kind.lower()
     if "v6" in kind:
         return 918e12
     if "v5p" in kind:
@@ -64,85 +88,191 @@ def peak_flops_per_chip() -> float:
         return 197e12
     if "v4" in kind:
         return 275e12
-    if "cpu" in kind or d.platform == "cpu":
+    if "cpu" in kind:
         return 100e9
     return 197e12
 
 
-def main(argv=None):
-    p = argparse.ArgumentParser()
-    p.add_argument("--size", default=None, help="tiny|1b|3b (default: by platform)")
-    p.add_argument("--cpu", action="store_true", help="force CPU (local smoke)")
-    p.add_argument("--slots", type=int, default=8)
-    p.add_argument("--prompt-len", type=int, default=120)
-    p.add_argument("--decode-steps", type=int, default=128)
-    p.add_argument("--windows", type=int, default=5)
-    p.add_argument("--context", type=int, default=1024)
-    args = p.parse_args(argv)
+def probe_accelerator(args) -> tuple[bool, str, str]:
+    """Probe accelerator init in a subprocess: a dead TPU tunnel hangs
+    jax.devices() forever, and a hung bench records nothing. The parent must
+    NEVER init JAX itself in serve mode — it would hold the chip and starve
+    the backend subprocess — so the probe also reports the device kind.
+    Returns (use_cpu, probe_error, device_kind)."""
+    if args.cpu:
+        return True, "", "cpu"
+    import subprocess
 
-    def note(msg):
-        print(f"[bench] {msg}", file=sys.stderr, flush=True)
-
-    # Probe accelerator init in a subprocess first: a dead TPU tunnel hangs
-    # jax.devices() forever, and a hung bench records nothing. A CPU fallback
-    # keeps the harness producing numbers, but they are marked non-comparable
-    # (vs_baseline null) and the probe's failure is recorded, not swallowed.
-    import os
-
-    use_cpu = args.cpu
-    probe_error = ""
-    if not use_cpu:
-        import subprocess
-
-        probe_timeout = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", "900"))
-        note(f"probing accelerator ({probe_timeout}s limit)...")
-        code = ("import time,jax; t=time.time(); d=jax.devices()[0]; "
-                "print('PROBE_OK', d.platform, getattr(d,'device_kind',''), "
-                "f'{time.time()-t:.0f}s', flush=True)")
-        try:
-            probe = subprocess.run([sys.executable, "-c", code],
-                                   capture_output=True, text=True,
-                                   timeout=probe_timeout)
-            ok = [l for l in (probe.stdout or "").splitlines()
-                  if l.startswith("PROBE_OK")]
-            if probe.returncode != 0 or not ok:
-                tail = (probe.stderr or "").strip().splitlines()[-8:]
-                probe_error = f"rc={probe.returncode}: " + " | ".join(tail)
-                note(f"probe FAILED — {probe_error}")
-                note("falling back to CPU (results will be non-comparable)")
-                use_cpu = True
-            else:
-                note(f"probe ok: {ok[-1]}")
-        except subprocess.TimeoutExpired as e:
-            tail = ""
-            for s in (e.stderr, e.stdout):
-                if s:
-                    s = s if isinstance(s, str) else s.decode(errors="replace")
-                    tail += " | ".join(s.strip().splitlines()[-4:])
-            probe_error = f"init timed out after {probe_timeout}s: {tail}"
-            note(f"probe TIMED OUT — {probe_error}")
+    probe_timeout = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", "900"))
+    note(f"probing accelerator ({probe_timeout}s limit)...")
+    code = ("import time,jax; t=time.time(); d=jax.devices()[0]; "
+            "print('PROBE_OK', d.platform, getattr(d,'device_kind',''), "
+            "f'{time.time()-t:.0f}s', flush=True)")
+    try:
+        probe = subprocess.run([sys.executable, "-c", code],
+                               capture_output=True, text=True,
+                               timeout=probe_timeout)
+        ok = [l for l in (probe.stdout or "").splitlines()
+              if l.startswith("PROBE_OK")]
+        if probe.returncode != 0 or not ok:
+            tail = (probe.stderr or "").strip().splitlines()[-8:]
+            err = f"rc={probe.returncode}: " + " | ".join(tail)
+            note(f"probe FAILED — {err}")
             note("falling back to CPU (results will be non-comparable)")
-            use_cpu = True
+            return True, err, "cpu"
+        note(f"probe ok: {ok[-1]}")
+        platform = ok[-1].split()[1]
+        kind = " ".join(ok[-1].split()[2:-1]) or platform
+        if platform == "cpu":
+            # a TPU-less machine: run the CPU smoke, never publish it as a
+            # comparable per-chip number
+            note("probe found only CPU — results will be non-comparable")
+            return True, "", "cpu"
+        return False, "", kind
+    except subprocess.TimeoutExpired as e:
+        tail = ""
+        for s in (e.stderr, e.stdout):
+            if s:
+                s = s if isinstance(s, str) else s.decode(errors="replace")
+                tail += " | ".join(s.strip().splitlines()[-4:])
+        err = f"init timed out after {probe_timeout}s: {tail}"
+        note(f"probe TIMED OUT — {err}")
+        note("falling back to CPU (results will be non-comparable)")
+        return True, err, "cpu"
 
+
+# --------------------------------------------------------------- serve mode
+
+def bench_serve(args, size: str, on_cpu: bool):
+    """Measure through the real process boundary: ModelManager-spawned gRPC
+    backend, PredictStream per request (what /v1/chat/completions rides)."""
+    import numpy as np
+
+    from localai_tpu.config import AppConfig, ModelConfig
+    from localai_tpu.core.manager import ModelManager
+
+    tmp = tempfile.mkdtemp(prefix="bench-ckpt-")
+    ckpt = write_synthetic_checkpoint(size, os.path.join(tmp, size))
+    os.environ["LOCALAI_ALLOW_SYNTHETIC"] = "1"  # inherited by the backend
+    dtype = args.dtype or ("int8" if size == "8b" else "bfloat16")
+    if on_cpu:
+        dtype = args.dtype or "float32"
+        os.environ["LOCALAI_JAX_PLATFORM"] = "cpu"
+    context = min(args.context, SIZES[size]["max_position_embeddings"])
+
+    mcfg = ModelConfig.from_dict({
+        "name": f"bench-{size}",
+        "backend": "llm",
+        "context_size": context,
+        "parallel": args.slots,
+        "dtype": dtype,
+        "prefill_buckets": [128, min(512, context)],
+        "parameters": {"model": ckpt},
+    })
+    app = AppConfig(models_path=tmp, parallel_requests=args.slots)
+    manager = ModelManager(app)
+    note(f"spawning backend subprocess (size={size} dtype={dtype} "
+         f"slots={args.slots} ctx={context})...")
+    t0 = time.perf_counter()
+    handle = manager.load(mcfg)
+    note(f"backend ready in {time.perf_counter() - t0:.1f}s")
+    vocab = SIZES[size]["vocab_size"]
+    seed_counter = iter(range(1, 1 << 30))
+    seed_lock = threading.Lock()
+
+    def stream(n_tokens, arrivals=None):
+        """One PredictStream request; returns (first_token_t, tokens).
+        Each call owns a fresh Generator — np Generators are not
+        thread-safe and the steady-state windows run these concurrently."""
+        with seed_lock:
+            seed = next(seed_counter)
+        rng = np.random.default_rng(seed)
+        ids = rng.integers(1, vocab, args.prompt_len).tolist()
+        first, n = None, 0
+        for reply in handle.client.predict_stream(
+                prompt_ids=ids, tokens=n_tokens, temperature=0.8, top_k=40,
+                seed=seed, ignore_eos=True,
+                timeout=3600.0):
+            now = time.perf_counter()
+            if reply.token_ids:  # token event (synthetic ckpts have no text)
+                n += 1
+                if first is None:
+                    first = now
+                if arrivals is not None:
+                    arrivals.append(now)
+        return first, n
+
+    try:
+        # warmup: compile prefill buckets + decode step through the wire
+        t0 = time.perf_counter()
+        ws = [threading.Thread(target=stream, args=(4,))
+              for _ in range(min(2, args.slots))]
+        [t.start() for t in ws]
+        [t.join() for t in ws]
+        stream(4)
+        note(f"warmup (compile) done in {time.perf_counter() - t0:.1f}s")
+
+        # TTFT: single request against the idle engine, through gRPC
+        ttfts = []
+        for _ in range(args.windows):
+            t0 = time.perf_counter()
+            first, _ = stream(2)
+            ttfts.append((first - t0) * 1e3)
+        ttft_ms = statistics.median(ttfts)
+        note(f"ttft p50 {ttft_ms:.1f}ms over {args.windows} runs")
+
+        # steady-state: all slots streaming concurrently; measure the window
+        # where every stream is live (max of firsts .. min of lasts)
+        tput = []
+        for w in range(args.windows):
+            arrivals_per = [[] for _ in range(args.slots)]
+            threads = [
+                threading.Thread(target=stream,
+                                 args=(args.decode_steps, arrivals_per[i]))
+                for i in range(args.slots)
+            ]
+            t0 = time.perf_counter()
+            [t.start() for t in threads]
+            [t.join() for t in threads]
+            wall = time.perf_counter() - t0
+            all_arr = sorted(a for arr in arrivals_per for a in arr)
+            lo = max(arr[0] for arr in arrivals_per if arr)
+            hi = min(arr[-1] for arr in arrivals_per if arr)
+            in_window = [a for a in all_arr if lo <= a <= hi]
+            if hi > lo and len(in_window) > args.slots:
+                tput.append((len(in_window) - 1) / (hi - lo))
+            else:  # degenerate window; fall back to wall-clock rate
+                tput.append(len(all_arr) / wall)
+            note(f"window {w}: {tput[-1]:.1f} tok/s "
+                 f"({len(all_arr)} tokens, wall {wall:.1f}s)")
+        return statistics.median(tput), ttft_ms, context, dtype
+    finally:
+        manager.stop_all()
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+# --------------------------------------------------------------- engine mode
+
+def bench_engine(args, size: str, on_cpu: bool):
+    """In-process Engine measurement (no RPC overhead) — kernel ceiling."""
     import jax
-
-    if use_cpu:
-        jax.config.update("jax_platforms", "cpu")
-    note("initializing device client...")
-    dev = jax.devices()[0]
-    on_cpu = dev.platform == "cpu"
-    size = args.size or ("tiny" if on_cpu else "1b")
-
     import numpy as np
 
     from localai_tpu.engine import Engine, EngineConfig, GenRequest
-    from localai_tpu.models.llama import init_params
+    from localai_tpu.engine.loader import load_config, load_params
     from localai_tpu.ops.sampling import SamplingParams
 
-    note(f"device={getattr(dev, 'device_kind', dev.platform)} size={size}")
-    cfg = flagship_config(size)
+    tmp = tempfile.mkdtemp(prefix="bench-ckpt-")
+    ckpt = write_synthetic_checkpoint(size, os.path.join(tmp, size))
+    os.environ["LOCALAI_ALLOW_SYNTHETIC"] = "1"
+    dtype = args.dtype or ("int8" if size == "8b" else "bfloat16")
+    if on_cpu:
+        dtype = args.dtype or "float32"
+    cfg = load_config(ckpt, dtype=dtype)
     context = min(args.context, cfg.max_position)
-    params = init_params(cfg, jax.random.PRNGKey(0))
+    params = load_params(ckpt, cfg, dtype=dtype)
     jax.block_until_ready(params)
     note("params initialized")
 
@@ -156,10 +286,10 @@ def main(argv=None):
     def req(n_tokens):
         return GenRequest(
             prompt_ids=rng.integers(1, cfg.vocab_size, args.prompt_len).tolist(),
-            params=SamplingParams(temperature=0.8, top_k=40, seed=int(rng.integers(1 << 30))),
+            params=SamplingParams(temperature=0.8, top_k=40,
+                                  seed=int(rng.integers(1 << 30))),
             max_tokens=n_tokens, ignore_eos=True)
 
-    # --- warmup: compile prefill bucket + decode step, run a few tokens
     t0 = time.perf_counter()
     for _ in range(args.slots):
         eng.submit(req(4))
@@ -167,7 +297,6 @@ def main(argv=None):
         pass
     note(f"warmup (compile) done in {time.perf_counter() - t0:.1f}s")
 
-    # --- TTFT: submit one request into the idle engine, time to first token
     ttfts = []
     for _ in range(args.windows):
         rid, out = eng.submit(req(2))
@@ -180,7 +309,6 @@ def main(argv=None):
     ttft_ms = statistics.median(ttfts)
     note(f"ttft done: {ttft_ms:.1f}ms")
 
-    # --- steady-state decode: all slots busy for the whole window
     tput = []
     for _ in range(args.windows):
         for _ in range(args.slots):
@@ -189,7 +317,6 @@ def main(argv=None):
             eng.step()
         n0 = eng.metrics["tokens_generated"]
         t0 = time.perf_counter()
-        # time only fully-batched steps
         steps = max(1, args.decode_steps - 8)
         for _ in range(steps):
             eng.step()
@@ -197,21 +324,60 @@ def main(argv=None):
         tput.append((eng.metrics["tokens_generated"] - n0) / dt)
         while eng.step():
             pass
-    toks_per_s = statistics.median(tput)
+    import shutil
 
-    n_params = param_count(cfg)
-    mfu = (toks_per_s * 2 * n_params) / peak_flops_per_chip()
+    shutil.rmtree(tmp, ignore_errors=True)
+    return statistics.median(tput), ttft_ms, context, dtype
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--size", default=None,
+                   help="tiny|1b|3b|8b (default: 8b on TPU, tiny on CPU)")
+    p.add_argument("--mode", default="serve", choices=["serve", "engine"],
+                   help="serve = gRPC backend subprocess (default); "
+                        "engine = in-process")
+    p.add_argument("--dtype", default=None,
+                   help="override weights dtype (default: int8 for 8b, else bf16)")
+    p.add_argument("--cpu", action="store_true", help="force CPU (local smoke)")
+    p.add_argument("--slots", type=int, default=8)
+    p.add_argument("--prompt-len", type=int, default=120)
+    p.add_argument("--decode-steps", type=int, default=128)
+    p.add_argument("--windows", type=int, default=5)
+    p.add_argument("--context", type=int, default=1024)
+    args = p.parse_args(argv)
+
+    on_cpu, probe_error, device_kind = probe_accelerator(args)
+    size = args.size or ("tiny" if on_cpu else "8b")
+
+    if args.mode == "serve":
+        # the parent process stays JAX-free: the backend subprocess owns the
+        # accelerator, exactly like production serving
+        toks_per_s, ttft_ms, context, dtype = bench_serve(args, size, on_cpu)
+    else:
+        import jax
+
+        if on_cpu:
+            jax.config.update("jax_platforms", "cpu")
+        note("initializing device client...")
+        dev = jax.devices()[0]
+        device_kind = getattr(dev, "device_kind", dev.platform)
+        toks_per_s, ttft_ms, context, dtype = bench_engine(args, size, on_cpu)
+
+    n_params = param_count(size)
+    mfu = (toks_per_s * 2 * n_params) / peak_flops_per_chip(device_kind)
 
     # BASELINE.md's north star is tok/s/chip for the flagship on a REAL chip:
     # a CPU run is a harness smoke, not a comparable number.
     result = {
-        "metric": f"decode tok/s/chip (llama-{size}, {args.slots} slots, ctx {context})",
+        "metric": f"decode tok/s/chip (llama-{size} {dtype}, {args.mode} path, "
+                  f"{args.slots} slots, ctx {context})",
         "value": round(toks_per_s, 2),
         "unit": "tok/s",
         "vs_baseline": None if on_cpu else round(toks_per_s / 1000.0, 4),
         "ttft_p50_ms": round(ttft_ms, 2),
         "mfu": None if on_cpu else round(mfu, 4),
-        "device": getattr(dev, "device_kind", dev.platform),
+        "device": device_kind,
         "params": n_params,
     }
     if on_cpu and not args.cpu:

@@ -18,7 +18,12 @@ from localai_tpu.backend import pb
 class BackendClient:
     def __init__(self, addr: str):
         self.addr = addr
-        self._channel = grpc.insecure_channel(addr)
+        # match the server's raised caps (server.py): a batched embedding
+        # reply (256 × 4096 f32) exceeds gRPC's 4MB default
+        self._channel = grpc.insecure_channel(addr, options=[
+            ("grpc.max_receive_message_length", 128 * 1024 * 1024),
+            ("grpc.max_send_message_length", 128 * 1024 * 1024),
+        ])
         self._calls = {}
         sym = pb._pb2
         for m in pb.SERVICE.methods:

@@ -191,6 +191,14 @@ def load_params(
             raise NotImplementedError("int8 quantization under a mesh")
         dtype = "bfloat16"
     dtype = jnp.dtype(dtype) if dtype is not None else cfg.jdtype
+
+    if _is_synthetic(model_dir):
+        # benchmark checkpoints: config.json declares the geometry, weights
+        # are deterministic random init on device — lets the serving path be
+        # measured at flagship scale without writing tens of GB to disk
+        return _synthetic_params(cfg, dtype=dtype, mesh=mesh,
+                                 quantize=quantize)
+
     r = _TensorReader(model_dir)
     specs = param_specs(cfg) if mesh is not None else None
 
@@ -252,11 +260,88 @@ def load_params(
     return params
 
 
-def load_model(model_dir: str, *, dtype=None, mesh=None):
-    """config.json + safetensors + tokenizer in one call → (cfg, params, tok)."""
+def _synthetic_params(cfg: LlamaConfig, *, dtype, mesh=None, quantize=False):
+    """Deterministic random params at any scale. The int8 case generates the
+    quantized {q, s} leaves DIRECTLY — an 8B bf16 intermediate would not fit
+    next to itself on a 16GB chip."""
+    from localai_tpu.models.llama import init_params
+    from localai_tpu.parallel.mesh import shard_params
+
+    if not quantize:
+        params = init_params(cfg, jax.random.PRNGKey(0), dtype=dtype)
+        if mesh is not None:
+            params = shard_params(params, param_specs(cfg), mesh)
+        return params
+
+    h, hd = cfg.hidden_size, cfg.head_dim
+    nh, nkv, L, inter = (cfg.num_heads, cfg.num_kv_heads, cfg.num_layers,
+                         cfg.intermediate_size)
+    key = jax.random.PRNGKey(0)
+
+    def qrand(k, shape, fan_in):
+        # int8 body + per-output-channel scale sized so dequantized weights
+        # have ~1/sqrt(fan_in) std, matching init_params' distribution
+        q = jax.random.randint(k, shape, -127, 128, jnp.int8)
+        s = jnp.full(shape[:-2] + (1, shape[-1]),
+                     (fan_in ** -0.5) / 73.0, jnp.float32)
+        return {"q": q, "s": s}
+
+    ks = jax.random.split(key, 10)
+    layers = {
+        "attn_norm": jnp.ones((L, h), dtype),
+        "wq": qrand(ks[0], (L, h, nh * hd), h),
+        "wk": qrand(ks[1], (L, h, nkv * hd), h),
+        "wv": qrand(ks[2], (L, h, nkv * hd), h),
+        "wo": qrand(ks[3], (L, nh * hd, h), nh * hd),
+        "mlp_norm": jnp.ones((L, h), dtype),
+        "w_gate": qrand(ks[4], (L, h, inter), h),
+        "w_up": qrand(ks[5], (L, h, inter), h),
+        "w_down": qrand(ks[6], (L, inter, h), inter),
+    }
+    if cfg.qkv_bias:
+        layers["bq"] = jnp.zeros((L, nh * hd), dtype)
+        layers["bk"] = jnp.zeros((L, nkv * hd), dtype)
+        layers["bv"] = jnp.zeros((L, nkv * hd), dtype)
+    params = {
+        "embed": (jax.random.normal(ks[7], (cfg.vocab_size, h), jnp.float32)
+                  * (h ** -0.5)).astype(dtype),
+        "layers": layers,
+        "final_norm": jnp.ones((h,), dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = qrand(ks[8], (h, cfg.vocab_size), h)
+    return params
+
+
+def _is_synthetic(model_dir: str) -> bool:
+    """True for benchmark checkpoints: config.json with
+    "localai_synthetic": true AND the LOCALAI_ALLOW_SYNTHETIC=1 env opt-in.
+    Without the opt-in a stray config key can never make a production server
+    silently serve random weights — the missing-safetensors error stands."""
+    if os.environ.get("LOCALAI_ALLOW_SYNTHETIC") != "1":
+        return False
+    try:
+        with open(os.path.join(model_dir, "config.json")) as fh:
+            return bool(json.load(fh).get("localai_synthetic"))
+    except (OSError, ValueError):
+        return False
+
+
+def load_tokenizer(model_dir: str):
+    """Tokenizer for a model dir; None for synthetic benchmark checkpoints
+    (callers drive the engine with prompt_ids)."""
     from localai_tpu.engine.tokenizer import Tokenizer
 
+    try:
+        return Tokenizer.from_dir(model_dir)
+    except FileNotFoundError:
+        if not _is_synthetic(model_dir):
+            raise
+        return None
+
+
+def load_model(model_dir: str, *, dtype=None, mesh=None):
+    """config.json + safetensors + tokenizer in one call → (cfg, params, tok)."""
     cfg = load_config(model_dir, dtype=dtype)
     params = load_params(model_dir, cfg, dtype=dtype, mesh=mesh)
-    tok = Tokenizer.from_dir(model_dir)
-    return cfg, params, tok
+    return cfg, params, load_tokenizer(model_dir)

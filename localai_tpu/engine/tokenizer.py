@@ -55,7 +55,12 @@ class Tokenizer:
 
     @classmethod
     def from_dir(cls, model_dir: str) -> "Tokenizer":
-        tok = _HFTokenizer.from_file(os.path.join(model_dir, "tokenizer.json"))
+        path = os.path.join(model_dir, "tokenizer.json")
+        if not os.path.isfile(path):
+            # the rust tokenizers lib raises a bare Exception for a missing
+            # file; callers need a catchable FileNotFoundError
+            raise FileNotFoundError(path)
+        tok = _HFTokenizer.from_file(path)
         cfg: dict[str, Any] = {}
         cfg_path = os.path.join(model_dir, "tokenizer_config.json")
         if os.path.exists(cfg_path):

@@ -13,11 +13,15 @@ import jax
 import jax.numpy as jnp
 
 
-def quantize(w, axis: int = -1):
-    """f32/bf16 weight → {"q": int8, "s": f32} with scales on `axis` kept."""
+def quantize(w):
+    """f32/bf16 weight [..., in, out] → {"q": int8, "s": f32 [..., 1, out]}.
+
+    Scales reduce over the INPUT axis only: leading dims (the stacked layer
+    axis of the scan layout) keep their own scales — reducing them away
+    would give every layer one shared scale AND break lax.scan's leading-axis
+    agreement between q [L, in, out] and s."""
     w32 = jnp.asarray(w, jnp.float32)
-    amax = jnp.max(jnp.abs(w32), axis=tuple(
-        i for i in range(w32.ndim) if i != (axis % w32.ndim)), keepdims=True)
+    amax = jnp.max(jnp.abs(w32), axis=-2, keepdims=True)
     scale = jnp.maximum(amax, 1e-8) / 127.0
     q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
     return {"q": q, "s": scale.astype(jnp.float32)}

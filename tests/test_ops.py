@@ -98,3 +98,49 @@ def test_sampling_penalties_suppress_repeats():
     toks, _, _ = sample(logits, st)
     # token 5 logit 2.0/2.0=1.0 < 1.5 → token 9 wins
     assert int(toks[0]) == 9
+
+
+def test_quantize_stacked_per_layer_scales():
+    """Stacked [L, in, out] weights must get PER-LAYER scales [L, 1, out] —
+    a collapsed leading axis breaks lax.scan and shares one scale across
+    layers (round-4 review finding)."""
+    from localai_tpu.ops.quant import dequantize, qmatmul, quantize
+
+    L, fin, fout = 3, 16, 8
+    w = jax.random.normal(jax.random.PRNGKey(0), (L, fin, fout))
+    w = w.at[1].multiply(100.0)  # wildly different per-layer magnitude
+    p = quantize(w)
+    assert p["q"].shape == (L, fin, fout)
+    assert p["s"].shape == (L, 1, fout)
+    assert float(p["s"][1].mean()) > 10 * float(p["s"][0].mean())
+    deq = dequantize(p, jnp.float32)
+    rel = jnp.abs(deq - w).max() / jnp.abs(w).max()
+    assert float(rel) < 0.02
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, fin))
+    np.testing.assert_allclose(np.asarray(qmatmul(x, {k: v[0] for k, v in p.items()})),
+                               np.asarray(x @ deq[0]), rtol=1e-4, atol=1e-4)
+
+
+def test_int8_checkpoint_load_and_forward():
+    """dtype=int8 through the REAL loader (quantize_params over the scan
+    layout) must forward without shape errors and stay close to f32."""
+    import sys
+    sys.path.insert(0, "tests")
+    from fixtures import build_tiny_checkpoint
+
+    import tempfile
+
+    from localai_tpu.engine import load_config, load_params
+    from localai_tpu.models.llama import forward_train
+
+    d = tempfile.mkdtemp(prefix="int8ckpt-")
+    build_tiny_checkpoint(d)
+    cfg32 = load_config(d, dtype="float32")
+    p32 = load_params(d, cfg32, dtype="float32")
+    cfg8 = load_config(d, dtype="int8")
+    p8 = load_params(d, cfg8, dtype="int8")
+    toks = jnp.arange(10)[None, :] % cfg32.vocab_size
+    ref = np.asarray(forward_train(p32, cfg32, toks))
+    out = np.asarray(forward_train(p8, cfg8, toks).astype(jnp.float32))
+    # int8 weights: argmax should survive even if logits wiggle
+    assert (ref.argmax(-1) == out.argmax(-1)).mean() > 0.8
