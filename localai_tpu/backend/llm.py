@@ -20,7 +20,10 @@ from localai_tpu.ops.sampling import SamplingParams
 
 
 class LLMServicer(BackendServicer):
-    def __init__(self):
+    def __init__(self, preloaded=None):
+        """`preloaded=(engine, cfg, tok, name)` serves an engine built by the
+        caller (the multi-host worker path, core/worker.py) — LoadModel then
+        reports already-loaded instead of constructing a second engine."""
         self.engine = None
         self.embedder = None
         self.tok = None
@@ -28,6 +31,9 @@ class LLMServicer(BackendServicer):
         self.model_name = ""
         self._state = pb.StatusResponse.UNINITIALIZED
         self._load_lock = threading.Lock()
+        if preloaded is not None:
+            self.engine, self.cfg, self.tok, self.model_name = preloaded
+            self._state = pb.StatusResponse.READY
 
     # ------------------------------------------------------------ lifecycle
 
@@ -48,8 +54,9 @@ class LLMServicer(BackendServicer):
         import jax
 
         from localai_tpu.engine import Engine, EngineConfig
-        from localai_tpu.engine.loader import load_config, load_params
-        from localai_tpu.engine.tokenizer import Tokenizer
+        from localai_tpu.engine.loader import (
+            load_config, load_params, load_tokenizer,
+        )
         from localai_tpu.engine.embedder import Embedder
         from localai_tpu.models.llama import max_model_axis
         from localai_tpu.parallel.mesh import MeshConfig, build_mesh
@@ -78,7 +85,7 @@ class LLMServicer(BackendServicer):
 
         params = load_params(model_dir, cfg, dtype=request.dtype or None,
                              mesh=mesh)
-        tok = Tokenizer.from_dir(model_dir)
+        tok = load_tokenizer(model_dir)
         context_size = request.context_size or min(2048, cfg.max_position)
         # single-shot prefill up to the chunk size; longer prompts prefill in
         # chunk-sized pieces interleaved with running decodes
@@ -144,6 +151,7 @@ class LLMServicer(BackendServicer):
             ignore_eos=request.ignore_eos,
             logprobs=request.logprobs,
             grammar=request.grammar,
+            context_shift=request.context_shift,
         )
         try:
             return self.engine.submit(req)
@@ -215,12 +223,27 @@ class LLMServicer(BackendServicer):
         if self.embedder is None:
             context.abort(grpc.StatusCode.FAILED_PRECONDITION,
                           "model loaded without embeddings=true")
+        if request.prompts:
+            # batched path: the whole input list in one RPC, one bucketed
+            # device call (reference transformers/backend.py:323 batches too)
+            if self.tok is None:
+                context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                              "no tokenizer; batched embeddings need one")
+            ids_batch = [self.tok.encode(p) for p in request.prompts]
+            try:
+                vecs = self.embedder.embed(ids_batch)
+            except ValueError as e:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            return pb.EmbeddingResult(
+                vectors=[pb.EmbeddingVector(values=v.tolist()) for v in vecs],
+                prompt_tokens=sum(len(i) for i in ids_batch))
         ids = self._prompt_ids(request, context)
         try:
             vec = self.embedder.embed([ids])[0]
         except ValueError as e:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
-        return pb.EmbeddingResult(embeddings=vec.tolist())
+        return pb.EmbeddingResult(embeddings=vec.tolist(),
+                                  prompt_tokens=len(ids))
 
     def Rerank(self, request, context):
         """Embedding-similarity rerank (reference Rerank RPC,

@@ -39,14 +39,20 @@ class Embedder:
         """[N] token-id lists → [N, H] f32 L2-normalized embeddings."""
         if not ids_batch:
             return np.zeros((0, self.cfg.hidden_size), np.float32)
+        n = len(ids_batch)
         longest = max(len(ids) for ids in ids_batch)
         bucket = self._bucket(max(longest, 1))
-        toks = np.zeros((len(ids_batch), bucket), np.int32)
-        lens = np.zeros((len(ids_batch),), np.int32)
+        # pad the BATCH dim to a power of two as well: arbitrary client batch
+        # sizes must not each compile a fresh XLA program
+        nb = 1
+        while nb < n:
+            nb *= 2
+        toks = np.zeros((nb, bucket), np.int32)
+        lens = np.zeros((nb,), np.int32)
         for i, ids in enumerate(ids_batch):
             toks[i, : len(ids)] = ids
             lens[i] = len(ids)
         with activate_mesh(self.mesh):
             out = self._fn(self.params, tokens=jnp.asarray(toks),
                            lengths=jnp.asarray(lens))
-        return np.asarray(jax.device_get(out))
+        return np.asarray(jax.device_get(out))[:n]

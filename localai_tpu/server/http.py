@@ -418,16 +418,13 @@ class API:
 
         handle.mark_busy()
         try:
-            vectors, total_tokens = [], 0
-            for text in inputs:
-                t = await asyncio.to_thread(
-                    lambda s=text: handle.client.tokenize(s))
-                total_tokens += t.length
-                r = await asyncio.to_thread(
-                    lambda s=text: handle.client.embedding(prompt=s))
-                vectors.append(list(r.embeddings))
+            # ONE RPC for the whole batch → one bucketed device call
+            # (a batch-256 request used to make 512 round trips)
+            r = await asyncio.to_thread(
+                lambda: handle.client.embedding(prompts=inputs))
+            vectors = [list(v.values) for v in r.vectors]
             return web.json_response(schema.embeddings_response(
-                cfg.name, vectors, total_tokens))
+                cfg.name, vectors, r.prompt_tokens))
         finally:
             handle.mark_idle()
 

@@ -97,6 +97,19 @@ def test_embedding_cosine_sanity(served):
     assert sim_ab > sim_ac  # near-duplicate closer than junk
 
 
+def test_embedding_batched_single_rpc(served):
+    """The whole input list rides ONE Embedding RPC (prompts field) and
+    matches the per-item path bitwise."""
+    client, _ = served
+    texts = ["the quick brown fox", "the quick brown foxes", "zzz qqq 123"]
+    r = client.embedding(prompts=texts)
+    assert len(r.vectors) == 3
+    assert r.prompt_tokens > 0
+    singles = [np.array(client.embedding(prompt=t).embeddings) for t in texts]
+    for v, s in zip(r.vectors, singles):
+        np.testing.assert_allclose(np.array(v.values), s, rtol=1e-5, atol=1e-6)
+
+
 def test_rerank(served):
     client, _ = served
     r = client.rerank(query="the quick brown fox",
