@@ -38,6 +38,7 @@ import numpy as np
 
 from localai_tpu.models.llama import (
     LlamaConfig,
+    cache_shift,
     decode_step,
     extend,
     init_kv_cache,
@@ -64,6 +65,9 @@ class EngineConfig:
     pipeline: bool = True         # keep one decode step in flight
     dtype: str | None = None      # default: model dtype
     mesh: Any | None = None       # jax.sharding.Mesh for TP/DP sharding
+    shift_keep: int = 4           # context-shift: sink tokens always kept
+    replicator: Any | None = None  # multi-host: rank-0 step broadcaster
+                                   # (parallel/distributed.Replicator)
 
 
 @dataclasses.dataclass
@@ -77,6 +81,8 @@ class GenRequest:
     ignore_eos: bool = False
     logprobs: bool = False
     grammar: str = ""             # GBNF; enforced via native matcher masks
+    context_shift: bool = False   # evict-and-continue past max_context
+                                  # (reference ctx_shift, backend.proto:22)
 
 
 @dataclasses.dataclass
@@ -109,6 +115,7 @@ class _Slot:
     prefill_pos: int = 0             # prompt tokens already written to KV
     row: Any = None                  # sampler row (installed at final chunk)
     counts_row: Any = None
+    shifted: int = 0                 # tokens evicted by context shifts
 
 
 class Engine:
@@ -256,6 +263,20 @@ class Engine:
             lengths = lengths + act
             return tokens, logprobs, kc, vc, sampler, logits, lengths
 
+        # multi-host: the engine's host decisions (tokens to write, slot
+        # indices, masks) must be readable on rank 0 even when slots shard
+        # over hosts — replicate the tiny per-step outputs
+        from localai_tpu.parallel.mesh import constrain
+        from jax.sharding import PartitionSpec as P
+
+        _decode_raw = _decode
+
+        def _decode(*a, **kw):
+            tokens, logprobs, kc, vc, sampler, logits, lengths = _decode_raw(
+                *a, **kw)
+            return (constrain(tokens, P(None)), constrain(logprobs, P(None)),
+                    kc, vc, sampler, logits, lengths)
+
         # donate the big carried buffers: cache stays in place in HBM.
         # mask_bits=None compiles a no-grammar variant with zero extra
         # host→device traffic on the common path.
@@ -263,10 +284,115 @@ class Engine:
         self._extend_mid_fn = jax.jit(_extend_mid, donate_argnums=(3, 4))
         self._extend_final_fn = jax.jit(_extend_final,
                                         donate_argnums=(3, 4, 5, 6, 7))
+        # context shift: keep/discard are static → one compiled program
+        self._shift_discard = max(
+            1, (self.ec.max_context - self.ec.shift_keep) // 2)
+        self._shift_fn = jax.jit(
+            partial(cache_shift, cfg, keep=self.ec.shift_keep,
+                    discard=self._shift_discard),
+            donate_argnums=(0, 1, 2))
         self._decode_fn = jax.jit(_decode, donate_argnums=(3, 4, 5, 6, 7),
                                   static_argnames=())
         self._decode_nomask_fn = jax.jit(
             partial(_decode, mask_bits=None), donate_argnums=(3, 4, 5, 6, 7))
+
+    # ------------------------------------------------------ device dispatch
+    # Every device call goes through one of these. On a multi-host mesh the
+    # rank-0 engine broadcasts (op, args) over the Replicator side channel
+    # first; follower ranks replay the identical sequence via follow() so the
+    # SPMD programs stay in lockstep (parallel/distributed.py).
+
+    def _bcast(self, op: str, **kw):
+        rep = self.ec.replicator
+        if rep is not None:
+            rep.broadcast(op, {
+                k: (np.asarray(v) if hasattr(v, "shape") or isinstance(
+                    v, (list, tuple)) else v)
+                for k, v in kw.items()})
+
+    def _dev_admit(self, ids, n, slot, row, counts_row):
+        self._bcast("admit", ids=ids, n=n, slot=slot,
+                    row={k: np.asarray(v) for k, v in row.items()},
+                    counts_row=counts_row)
+        with activate_mesh(self.mesh):
+            (self._kc, self._vc, self._sampler, self._last_logits,
+             self._lengths) = self._admit_fn(
+                self.params, self._cos, self._sin,
+                self._kc, self._vc, self._sampler, self._last_logits,
+                self._lengths,
+                jnp.asarray(ids), jnp.int32(n), jnp.int32(slot),
+                {k: jnp.asarray(v) for k, v in row.items()},
+                jnp.asarray(counts_row),
+            )
+
+    def _dev_extend_mid(self, buf, pos, idx):
+        self._bcast("extend_mid", buf=buf, pos=pos, idx=idx)
+        with activate_mesh(self.mesh):
+            self._kc, self._vc = self._extend_mid_fn(
+                self.params, self._cos, self._sin, self._kc, self._vc,
+                jnp.asarray(buf), jnp.int32(pos), jnp.int32(idx))
+
+    def _dev_extend_final(self, buf, pos, nvalid, idx, row, counts_row):
+        self._bcast("extend_final", buf=buf, pos=pos, nvalid=nvalid, idx=idx,
+                    row={k: np.asarray(v) for k, v in row.items()},
+                    counts_row=counts_row)
+        with activate_mesh(self.mesh):
+            (self._kc, self._vc, self._sampler, self._last_logits,
+             self._lengths) = self._extend_final_fn(
+                self.params, self._cos, self._sin,
+                self._kc, self._vc, self._sampler, self._last_logits,
+                self._lengths, jnp.asarray(buf), jnp.int32(pos),
+                jnp.int32(nvalid), jnp.int32(idx),
+                {k: jnp.asarray(v) for k, v in row.items()},
+                jnp.asarray(counts_row))
+
+    def _dev_decode(self, active, mask_host=None):
+        self._bcast("decode", active=active,
+                    mask=None if mask_host is None else mask_host)
+        with activate_mesh(self.mesh):
+            args = (self.params, self._cos, self._sin,
+                    self._kc, self._vc, self._sampler, self._last_logits,
+                    self._lengths, jnp.asarray(active))
+            if mask_host is not None:
+                (tokens, logprobs, self._kc, self._vc, self._sampler,
+                 self._last_logits, self._lengths) = self._decode_fn(
+                    *args, jnp.asarray(mask_host))
+            else:
+                (tokens, logprobs, self._kc, self._vc, self._sampler,
+                 self._last_logits, self._lengths) = self._decode_nomask_fn(
+                    *args)
+        return tokens, logprobs
+
+    def _dev_shift(self, idx):
+        self._bcast("shift", idx=idx)
+        with activate_mesh(self.mesh):
+            self._kc, self._vc, self._lengths = self._shift_fn(
+                self._kc, self._vc, self._lengths, jnp.int32(idx))
+
+    def follow(self, channel) -> None:
+        """Follower-rank loop (multi-host, process_index > 0): replay the
+        rank-0 engine's device dispatches against this process's shards of
+        the same global arrays. Blocks until rank 0 sends `stop` or the
+        channel drops."""
+        while True:
+            try:
+                op, kw = channel.recv()
+            except (ConnectionError, EOFError):
+                return
+            if op == "stop":
+                return
+            if op == "admit":
+                self._dev_admit(kw["ids"], kw["n"], kw["slot"], kw["row"],
+                                kw["counts_row"])
+            elif op == "extend_mid":
+                self._dev_extend_mid(kw["buf"], kw["pos"], kw["idx"])
+            elif op == "extend_final":
+                self._dev_extend_final(kw["buf"], kw["pos"], kw["nvalid"],
+                                       kw["idx"], kw["row"], kw["counts_row"])
+            elif op == "decode":
+                self._dev_decode(kw["active"], kw["mask"])
+            elif op == "shift":
+                self._dev_shift(kw["idx"])
 
     # ------------------------------------------------------------ submission
 
@@ -349,15 +475,7 @@ class Engine:
         if not chunked:
             ids = np.zeros((1, bucket), np.int32)
             ids[0, :n] = req.prompt_ids
-            with activate_mesh(self.mesh):
-                (self._kc, self._vc, self._sampler, self._last_logits,
-                 self._lengths) = self._admit_fn(
-                    self.params, self._cos, self._sin,
-                    self._kc, self._vc, self._sampler, self._last_logits,
-                    self._lengths,
-                    jnp.asarray(ids), jnp.int32(n), jnp.int32(slot),
-                    row, jnp.asarray(counts_row),
-                )
+            self._dev_admit(ids, n, slot, row, counts_row)
 
         self._slots[slot] = _Slot(
             request_id=rid, req=req, out=out,
@@ -390,19 +508,11 @@ class Engine:
             buf = np.zeros((1, self._chunk), np.int32)
             buf[0, :nvalid] = ids[pos:pos + nvalid]
             final = pos + nvalid == len(ids)
-            with activate_mesh(self.mesh):
-                if final:
-                    (self._kc, self._vc, self._sampler, self._last_logits,
-                     self._lengths) = self._extend_final_fn(
-                        self.params, self._cos, self._sin,
-                        self._kc, self._vc, self._sampler, self._last_logits,
-                        self._lengths, jnp.asarray(buf), jnp.int32(pos),
-                        jnp.int32(nvalid), jnp.int32(idx), slot.row,
-                        jnp.asarray(slot.counts_row))
-                else:
-                    self._kc, self._vc = self._extend_mid_fn(
-                        self.params, self._cos, self._sin, self._kc, self._vc,
-                        jnp.asarray(buf), jnp.int32(pos), jnp.int32(idx))
+            if final:
+                self._dev_extend_final(buf, pos, nvalid, idx, slot.row,
+                                       slot.counts_row)
+            else:
+                self._dev_extend_mid(buf, pos, idx)
             slot.prefill_pos = pos + nvalid
             if final:
                 slot.prefilled = True
@@ -429,18 +539,8 @@ class Engine:
             return None
         entries = [(int(i), self._slots[i].request_id)
                    for i in np.where(active)[0]]
-        with activate_mesh(self.mesh):
-            args = (self.params, self._cos, self._sin,
-                    self._kc, self._vc, self._sampler, self._last_logits,
-                    self._lengths, jnp.asarray(active))
-            if self._grammar_slots > 0:
-                (tokens, logprobs, self._kc, self._vc, self._sampler,
-                 self._last_logits, self._lengths) = self._decode_fn(
-                    *args, jnp.asarray(self._mask_host))
-            else:
-                (tokens, logprobs, self._kc, self._vc, self._sampler,
-                 self._last_logits, self._lengths) = self._decode_nomask_fn(
-                    *args)
+        tokens, logprobs = self._dev_decode(
+            active, self._mask_host if self._grammar_slots > 0 else None)
         return tokens, logprobs, entries
 
     def _consume(self, pend):
@@ -493,13 +593,21 @@ class Engine:
         self.metrics["tokens_generated"] += 1
 
         finish = None
+        cache_len = slot.prompt_len + slot.generated - slot.shifted
         if (not slot.req.ignore_eos and self.tok is not None
                 and token_id in self.tok.eos_ids):
             finish = "eos"
         elif slot.generated >= slot.req.max_tokens:
             finish = "length"
-        elif slot.prompt_len + slot.generated >= self.ec.max_context - 1:
-            finish = "length"
+        elif cache_len >= self.ec.max_context - 2:
+            if slot.req.context_shift:
+                # evict-and-continue (reference ctx_shift): slide the cache
+                # left, re-rotating K; the in-flight pipelined step wrote at a
+                # pre-shift position and is already part of the device state
+                self._dev_shift(idx)
+                slot.shifted += self._shift_discard
+            else:
+                finish = "length"
 
         # grammar: advance the PDA with the sampled token, refresh the mask
         if slot.matcher is not None and finish is None:

@@ -223,6 +223,14 @@ def _mlp(x, lp):
 _shard_act = constrain
 
 
+def _seq_ax():
+    """'seq' when the ambient mesh carries the ring-attention axis, else None
+    (specs naming absent axes would raise)."""
+    from localai_tpu.parallel.mesh import current_mesh, seq_axis_size
+
+    return "seq" if seq_axis_size(current_mesh()) > 1 else None
+
+
 def _attn_impls(cfg: LlamaConfig | None = None):
     """Select attention kernels at trace time: Pallas (fused, online-softmax)
     on single-chip TPU; XLA reference under a mesh (GSPMD shards the einsums)
@@ -234,6 +242,20 @@ def _attn_impls(cfg: LlamaConfig | None = None):
 
     force = os.environ.get("LOCALAI_FORCE_PALLAS") == "1"
     block = os.environ.get("LOCALAI_NO_PALLAS") == "1"
+    mesh = current_mesh()
+    if mesh is not None and not force:
+        from localai_tpu.parallel.mesh import seq_axis_size
+
+        if seq_axis_size(mesh) > 1:
+            # sequence parallelism: prefill rides the ppermute ring over the
+            # 'seq' axis (parallel/ring_attention.py); decode (S=1) stays on
+            # the XLA path with GSPMD sharding
+            from localai_tpu.parallel.ring_attention import ring_prefill
+
+            return (lambda q, k, v, lengths, sliding_window=None:
+                    ring_prefill(q, k, v, lengths, mesh=mesh,
+                                 sliding_window=sliding_window),
+                    mha_decode)
     use = force or (not block and jax.default_backend() == "tpu"
                     and current_mesh() is None)
     if use and not force:
@@ -269,7 +291,7 @@ def prefill(params, cfg: LlamaConfig, tokens, lengths, cos, sin,
     attn_prefill, _ = _attn_impls(cfg)
     positions = jnp.arange(s)[None, :].repeat(b, 0)
     x = params["embed"].astype(cfg.jdtype)[tokens]
-    x = _shard_act(x, P("data", None, None))
+    x = _shard_act(x, P("data", _seq_ax(), None))
 
     def layer(x, xs):
         lp, kc, vc = xs
@@ -277,12 +299,12 @@ def prefill(params, cfg: LlamaConfig, tokens, lengths, cos, sin,
         q, k, v = _qkv(h, lp, cfg)
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
-        q = _shard_act(q, P("data", None, "model", None))
+        q = _shard_act(q, P("data", _seq_ax(), "model", None))
         attn = attn_prefill(q, k, v, lengths, sliding_window=cfg.sliding_window)
         x = x + qmatmul(attn.reshape(b, s, -1), lp["wo"])
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         x = x + _mlp(h, lp)
-        x = _shard_act(x, P("data", None, None))
+        x = _shard_act(x, P("data", _seq_ax(), None))
         kc, vc = _cache_write(kc, vc, k, v, slot_map, positions)
         return x, (kc, vc)
 
@@ -350,19 +372,19 @@ def hidden_states(params, cfg: LlamaConfig, tokens, lengths=None):
         lengths = jnp.full((b,), s, jnp.int32)
     attn_prefill, _ = _attn_impls(cfg)
     x = params["embed"].astype(cfg.jdtype)[tokens]
-    x = _shard_act(x, P("data", None, None))
+    x = _shard_act(x, P("data", _seq_ax(), None))
 
     def layer(x, lp):
         h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
         q, k, v = _qkv(h, lp, cfg)
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
-        q = _shard_act(q, P("data", None, "model", None))
+        q = _shard_act(q, P("data", _seq_ax(), "model", None))
         attn = attn_prefill(q, k, v, lengths, sliding_window=cfg.sliding_window)
         x = x + qmatmul(attn.reshape(b, s, -1), lp["wo"])
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         x = x + _mlp(h, lp)
-        x = _shard_act(x, P("data", None, None))
+        x = _shard_act(x, P("data", _seq_ax(), None))
         return x, None
 
     x, _ = jax.lax.scan(layer, x, params["layers"])
@@ -418,6 +440,42 @@ def extend(params, cfg: LlamaConfig, tokens, start, cos, sin,
         return _lm_head(x.astype(jnp.float32), params), k_cache, v_cache
     logits = _lm_head(x.astype(jnp.float32), params)
     return logits, k_cache, v_cache
+
+
+def cache_shift(cfg: LlamaConfig, k_cache, v_cache, lengths, slot, *,
+                keep: int, discard: int):
+    """llama.cpp-style context shift for one slot (reference ctx_shift,
+    /root/reference/backend/cpp/llama-cpp/grpc-server.cpp:311): keep the
+    first `keep` sink tokens, evict the next `discard`, slide the rest left.
+
+    Cached K is stored post-RoPE, so the moved entries are re-rotated by
+    -discard positions (a pure rotation by angle -discard·inv_freq — the
+    YaRN/llama3 attention mscale is a uniform factor and commutes with it).
+    `keep`/`discard` are static → one compiled program per engine.
+    Returns (k_cache, v_cache, lengths) with lengths[slot] -= discard.
+    """
+    from localai_tpu.ops.rope import rope_freqs
+
+    inv_freq, _ = rope_freqs(cfg.rope)
+    ang = discard * inv_freq                     # [D/2]
+    c, s = jnp.cos(ang), jnp.sin(ang)
+
+    T = k_cache.shape[3]
+    ks = k_cache[:, slot]                        # [L, KVH, T, D]
+    vs = v_cache[:, slot]
+    ks_m = jnp.roll(ks, -discard, axis=2)
+    vs_m = jnp.roll(vs, -discard, axis=2)
+    # R(-d): x1' = x1·cos + x2·sin ; x2' = x2·cos - x1·sin
+    x1, x2 = jnp.split(ks_m.astype(jnp.float32), 2, axis=-1)
+    ks_rot = jnp.concatenate([x1 * c + x2 * s, x2 * c - x1 * s],
+                             axis=-1).astype(ks.dtype)
+    idx = jnp.arange(T)[None, None, :, None]
+    length = lengths[slot]
+    move = (idx >= keep) & (idx < length - discard)
+    k_cache = k_cache.at[:, slot].set(jnp.where(move, ks_rot, ks))
+    v_cache = v_cache.at[:, slot].set(jnp.where(move, vs_m, vs))
+    lengths = lengths.at[slot].add(-discard)
+    return k_cache, v_cache, lengths
 
 
 def forward_train(params, cfg: LlamaConfig, tokens):

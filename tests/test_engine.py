@@ -313,3 +313,86 @@ def test_pipeline_matches_sync_mode(loaded):
         return [_drain(q) for _, q in outs]
 
     assert run(True) == run(False)
+
+
+def test_context_shift_rotation_unit():
+    """cache_shift mechanics: a K row written at position p must, after the
+    shift, equal the same raw vector roped at position p-discard; V rows move
+    verbatim; sink rows stay; lengths drops by discard."""
+    import jax
+
+    from localai_tpu.models.llama import LlamaConfig, cache_shift
+    from localai_tpu.ops.rope import apply_rope, rope_table
+
+    cfg = LlamaConfig(vocab_size=64, hidden_size=16, intermediate_size=32,
+                      num_layers=2, num_heads=2, num_kv_heads=2, head_dim=8,
+                      max_position=64, dtype="float32")
+    L, B, KVH, T, D = 2, 2, 2, 32, 8
+    keep, discard, length = 3, 10, 30
+    cos, sin = rope_table(cfg.rope, T)
+    raw = jax.random.normal(jax.random.PRNGKey(0), (L, B, KVH, T, D))
+    positions = jnp.arange(T)[None, :].repeat(L * B * KVH, 0).reshape(
+        L, B, KVH, T)
+    # roped[l,b,h,p] = R(p)·raw  (apply_rope wants [..., seq, heads, dim])
+    roped = apply_rope(raw.transpose(0, 1, 3, 2, 4).reshape(L * B, T, KVH, D),
+                       cos, sin, jnp.arange(T)[None, :].repeat(L * B, 0))
+    kc = roped.reshape(L, B, T, KVH, D).transpose(0, 1, 3, 2, 4)
+    vc = jax.random.normal(jax.random.PRNGKey(1), (L, B, KVH, T, D))
+    lengths = jnp.array([length, 5], jnp.int32)
+
+    kc2, vc2, lengths2 = cache_shift(cfg, kc, vc, lengths, 0,
+                                     keep=keep, discard=discard)
+    assert int(lengths2[0]) == length - discard
+    assert int(lengths2[1]) == 5           # other slot untouched
+    np.testing.assert_allclose(np.asarray(kc2[:, 1]), np.asarray(kc[:, 1]))
+    # sink rows unchanged
+    np.testing.assert_allclose(np.asarray(kc2[:, 0, :, :keep]),
+                               np.asarray(kc[:, 0, :, :keep]), rtol=1e-6)
+    # moved V rows verbatim
+    np.testing.assert_allclose(
+        np.asarray(vc2[:, 0, :, keep:length - discard]),
+        np.asarray(vc[:, 0, :, keep + discard:length]), rtol=1e-6)
+    # moved K rows = raw re-roped at the new position
+    expect = apply_rope(
+        raw.transpose(0, 1, 3, 2, 4).reshape(L * B, T, KVH, D),
+        cos, sin,
+        (jnp.arange(T) - discard)[None, :].repeat(L * B, 0) % T,
+    ).reshape(L, B, T, KVH, D).transpose(0, 1, 3, 2, 4)
+    np.testing.assert_allclose(
+        np.asarray(kc2[:, 0, :, keep:length - discard]),
+        np.asarray(expect[:, 0, :, keep + discard:length]),
+        rtol=1e-4, atol=1e-5)
+
+
+def test_context_shift_generation_crosses_limit(loaded):
+    """A context_shift request keeps generating past the context cap (bounded
+    memory) and ends with finish_reason=length from max_tokens — while a
+    non-shift request dies at the cap."""
+    cfg, params, tok = loaded
+    ctx = 48
+    prompt = tok.encode("the quick brown fox jumps over")
+    n = len(prompt)
+
+    def run(shift):
+        eng = Engine(cfg, params, tok, EngineConfig(
+            max_slots=2, max_context=ctx, prefill_buckets=(32,)))
+        req = GenRequest(list(prompt), SamplingParams(temperature=0.0),
+                         max_tokens=3 * ctx, ignore_eos=True,
+                         context_shift=shift)
+        _, out = eng.submit(req)
+        outs = []
+        for _ in range(4000):
+            if not eng.step():
+                break
+        while not out.empty():
+            outs.append(out.get())
+        return outs
+
+    plain = run(False)
+    assert plain[-1].finish_reason == "length"
+    assert plain[-1].generated_tokens <= ctx - n  # capped by the context
+
+    shifted = run(True)
+    assert shifted[-1].finish_reason == "length"
+    assert shifted[-1].generated_tokens == 3 * ctx  # sailed past the cap
+    assert all(o.token_id >= 0 for o in shifted)
