@@ -70,11 +70,14 @@ def _make_base():
 
 
 def serve(addr: str = "127.0.0.1:50051", backend: str = "llm",
-          max_workers: int = 16):
-    """Start a backend server; returns (grpc.Server, servicer, bound_port)."""
-    if backend not in ROLES:
-        raise ValueError(f"unknown backend role {backend!r}; have {sorted(ROLES)}")
-    servicer = ROLES[backend]()
+          max_workers: int = 16, servicer=None):
+    """Start a backend server; returns (grpc.Server, servicer, bound_port).
+    `servicer` overrides role construction (multi-host worker preloads one)."""
+    if servicer is None:
+        if backend not in ROLES:
+            raise ValueError(
+                f"unknown backend role {backend!r}; have {sorted(ROLES)}")
+        servicer = ROLES[backend]()
     server = grpc.server(
         futures.ThreadPoolExecutor(max_workers=max_workers),
         options=[("grpc.max_receive_message_length", 128 * 1024 * 1024),
@@ -88,8 +91,9 @@ def serve(addr: str = "127.0.0.1:50051", backend: str = "llm",
     return server, servicer, port
 
 
-def serve_blocking(addr: str = "127.0.0.1:50051", backend: str = "llm") -> int:
-    server, servicer, port = serve(addr, backend)
+def serve_blocking(addr: str = "127.0.0.1:50051", backend: str = "llm",
+                   servicer=None) -> int:
+    server, servicer, port = serve(addr, backend, servicer=servicer)
     print(f"backend[{backend}] serving on port {port}", flush=True)
     stop = threading.Event()
 
@@ -103,3 +107,8 @@ def serve_blocking(addr: str = "127.0.0.1:50051", backend: str = "llm") -> int:
         servicer.shutdown()
     server.stop(grace=5).wait(10)
     return 0
+
+
+def serve_preloaded(addr: str, servicer) -> int:
+    """Serve an already-constructed servicer (multi-host worker rank 0)."""
+    return serve_blocking(addr, backend="worker", servicer=servicer)

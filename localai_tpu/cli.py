@@ -52,6 +52,27 @@ def _add_federated(sub):
     return p
 
 
+def _add_worker(sub):
+    p = sub.add_parser(
+        "worker",
+        help="join a multi-host serving job (reference: worker_llamacpp.go)")
+    p.add_argument("--coordinator", default=None,
+                   help="jax.distributed coordinator host:port (rank 0's host)")
+    p.add_argument("--num-processes", type=int, default=None)
+    p.add_argument("--process-id", type=int, default=None)
+    p.add_argument("--model", required=True, help="model directory (all ranks)")
+    p.add_argument("--dtype", default=None)
+    p.add_argument("--context-size", type=int, default=None)
+    p.add_argument("--parallel", type=int, default=4)
+    p.add_argument("--mesh-data", type=int, default=None)
+    p.add_argument("--mesh-model", type=int, default=None)
+    p.add_argument("--replicate-port", type=int, default=39219,
+                   help="rank 0's dispatch-broadcast port")
+    p.add_argument("--addr", default="127.0.0.1:50051",
+                   help="rank 0's gRPC backend bind address")
+    return p
+
+
 def _add_models(sub):
     p = sub.add_parser("models", help="list or install models")
     p.add_argument("action", choices=["list", "install"], nargs="?", default="list")
@@ -71,6 +92,7 @@ def main(argv=None):
     _add_backend(sub)
     _add_models(sub)
     _add_federated(sub)
+    _add_worker(sub)
     sub.add_parser("version", help="print version")
 
     args = parser.parse_args(argv)
@@ -96,6 +118,10 @@ def main(argv=None):
         from localai_tpu.federation import run_federated
 
         return run_federated(args)
+    if cmd == "worker":
+        from localai_tpu.core.worker import run_worker
+
+        return run_worker(args)
     if cmd == "run":
         from localai_tpu.server.http import run_server
 

@@ -1,0 +1,158 @@
+"""Multi-host serving: jax.distributed bring-up + the lockstep follower
+protocol that lets ONE engine host loop drive a model sharded across hosts.
+
+Reference parity: the llama.cpp RPC worker path — a master registers remote
+device workers and streams tensor work to them
+(/root/reference/backend/cpp/llama-cpp/grpc-server.cpp:256-278, worker CLI
+/root/reference/core/cli/worker/worker_llamacpp.go:66-92). The TPU-native
+answer is multi-controller SPMD: every process runs the SAME jitted
+computations on its local shard of a global mesh and XLA's collectives ride
+ICI/DCN. What llama.cpp ships as tensors over TCP, we ship as a few hundred
+BYTES of host args per step (token ids, slot indices, masks) — the device
+data never leaves the chips.
+
+Mechanics: rank 0 runs the real Engine (admission, sampling bookkeeping,
+streams). Every device dispatch is prefixed by a broadcast of (op, host args)
+over a TCP side channel; follower ranks replay the identical call sequence
+into their own engine state, which holds the locally-addressable shards of
+the same global arrays. Host args are bit-identical → traces are identical →
+SPMD stays in lockstep.
+"""
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+import pickle
+import socket
+import struct
+import threading
+
+_LEN = struct.Struct(">I")
+
+
+def _token_digest(token: str | None) -> bytes:
+    """32-byte handshake proof. LOCALAI_REPLICATE_TOKEN overrides the default
+    (the coordinator address) for deployments that want a real shared secret."""
+    secret = os.environ.get("LOCALAI_REPLICATE_TOKEN") or token or "localai"
+    return hashlib.sha256(secret.encode()).digest()
+
+
+def init_distributed(coordinator: str | None = None,
+                     num_processes: int | None = None,
+                     process_id: int | None = None) -> int:
+    """jax.distributed.initialize from args or LOCALAI_* env vars. Returns
+    this process's rank. No-op (rank 0) when unconfigured."""
+    import jax
+
+    coordinator = coordinator or os.environ.get("LOCALAI_COORDINATOR")
+    if num_processes is None:
+        num_processes = int(os.environ.get("LOCALAI_NUM_PROCESSES", "0") or 0)
+    if process_id is None:
+        pid = os.environ.get("LOCALAI_PROCESS_ID")
+        process_id = int(pid) if pid is not None else None
+    if not coordinator or not num_processes or num_processes <= 1:
+        return 0
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    return jax.process_index()
+
+
+def _send_msg(sock: socket.socket, payload: bytes):
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_msg(sock: socket.socket) -> bytes:
+    hdr = b""
+    while len(hdr) < _LEN.size:
+        chunk = sock.recv(_LEN.size - len(hdr))
+        if not chunk:
+            raise ConnectionError("follower channel closed")
+        hdr += chunk
+    (n,) = _LEN.unpack(hdr)
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(65536, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("follower channel closed mid-message")
+        buf += chunk
+    return bytes(buf)
+
+
+class Replicator:
+    """Rank-0 side: accepts `num_followers` connections, then broadcast()
+    ships each (op, kwargs) to every follower before the local dispatch.
+
+    A connection only counts as a follower after it presents the shared-token
+    digest — a stray connection can neither occupy a follower slot nor
+    receive the dispatch stream."""
+
+    def __init__(self, port: int, num_followers: int, host: str = "0.0.0.0",
+                 accept_timeout: float = 300.0, token: str | None = None):
+        self.num_followers = num_followers
+        self._expect = _token_digest(token)
+        self._srv = socket.create_server((host, port))
+        self._srv.settimeout(accept_timeout)
+        self._conns: list[socket.socket] = []
+        self._lock = threading.Lock()
+
+    @property
+    def port(self) -> int:
+        return self._srv.getsockname()[1]
+
+    def wait_for_followers(self):
+        while len(self._conns) < self.num_followers:
+            conn, peer = self._srv.accept()
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            try:
+                conn.settimeout(10.0)
+                proof = _recv_msg(conn)
+                conn.settimeout(None)
+            except (ConnectionError, OSError):
+                conn.close()
+                continue
+            if not hmac.compare_digest(proof, self._expect):
+                import logging
+
+                logging.getLogger("localai_tpu").warning(
+                    "replicator: rejected connection from %s (bad token)",
+                    peer)
+                conn.close()
+                continue
+            self._conns.append(conn)
+
+    def broadcast(self, op: str, kwargs: dict):
+        payload = pickle.dumps((op, kwargs), protocol=pickle.HIGHEST_PROTOCOL)
+        with self._lock:
+            for c in self._conns:
+                _send_msg(c, payload)
+
+    def close(self):
+        try:
+            self.broadcast("stop", {})
+        except OSError:
+            pass
+        for c in self._conns:
+            c.close()
+        self._srv.close()
+
+
+class Follower:
+    """Rank>0 side: connect to rank 0's Replicator and iterate messages."""
+
+    def __init__(self, addr: str, connect_timeout: float = 300.0,
+                 token: str | None = None):
+        host, _, port = addr.rpartition(":")
+        self._sock = socket.create_connection((host or "127.0.0.1",
+                                               int(port)),
+                                              timeout=connect_timeout)
+        self._sock.settimeout(None)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        _send_msg(self._sock, _token_digest(token))
+
+    def recv(self) -> tuple[str, dict]:
+        return pickle.loads(_recv_msg(self._sock))
+
+    def close(self):
+        self._sock.close()

@@ -1,0 +1,141 @@
+"""Multi-host serving tests: REAL multi-process jax.distributed over virtual
+CPU devices — 2 processes × 4 devices = one 8-device global mesh, rank 0
+driving the Engine, rank 1 replaying via the Follower protocol
+(parallel/distributed.py). The reference has no automated multi-node tests
+(SURVEY §4); this is the worker_llamacpp.go role under test.
+
+These spawn fresh subprocesses (jax.distributed can't re-init in-process), so
+they manage their own JAX env instead of the session conftest's.
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+from fixtures import tiny_checkpoint
+
+_RANK_SCRIPT = r"""
+import json, os, sys
+rank = int(sys.argv[1]); nproc = int(sys.argv[2])
+coord_port, rep_port, ckpt, out_path = sys.argv[3], int(sys.argv[4]), sys.argv[5], sys.argv[6]
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "float32")
+from localai_tpu.parallel.distributed import Follower, Replicator, init_distributed
+init_distributed(f"127.0.0.1:{coord_port}", nproc, rank)
+assert len(jax.devices()) == 4 * nproc
+assert len(jax.local_devices()) == 4
+
+from localai_tpu.engine import Engine, EngineConfig, GenRequest
+from localai_tpu.engine.loader import load_config, load_params, load_tokenizer
+from localai_tpu.ops.sampling import SamplingParams
+from localai_tpu.parallel.mesh import MeshConfig, build_mesh
+
+mesh = build_mesh(MeshConfig(data=2, model=4))
+cfg = load_config(ckpt, dtype="float32")
+params = load_params(ckpt, cfg, dtype="float32", mesh=mesh)
+tok = load_tokenizer(ckpt)
+
+rep = Replicator(rep_port, nproc - 1, host="127.0.0.1") if rank == 0 else None
+eng = Engine(cfg, params, tok, EngineConfig(
+    max_slots=2, max_context=64, prefill_buckets=(16,), mesh=mesh,
+    replicator=rep))
+
+if rank == 0:
+    rep.wait_for_followers()
+    prompt = tok.encode("pack my box with five dozen")
+    toks = [o.token_id for o in eng.generate(GenRequest(
+        list(prompt), SamplingParams(temperature=0.0), max_tokens=8,
+        ignore_eos=True))]
+    rep.close()
+    json.dump(toks, open(out_path, "w"))
+else:
+    chan = Follower(f"127.0.0.1:{rep_port}")
+    eng.follow(chan)
+    chan.close()
+print(f"RANK_{rank}_DONE", flush=True)
+"""
+
+_SINGLE_SCRIPT = r"""
+import json, os, sys
+ckpt, out_path = sys.argv[1], sys.argv[2]
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "float32")
+from localai_tpu.engine import Engine, EngineConfig, GenRequest
+from localai_tpu.engine.loader import load_config, load_params, load_tokenizer
+from localai_tpu.ops.sampling import SamplingParams
+from localai_tpu.parallel.mesh import MeshConfig, build_mesh
+mesh = build_mesh(MeshConfig(data=2, model=4))
+cfg = load_config(ckpt, dtype="float32")
+params = load_params(ckpt, cfg, dtype="float32", mesh=mesh)
+tok = load_tokenizer(ckpt)
+eng = Engine(cfg, params, tok, EngineConfig(
+    max_slots=2, max_context=64, prefill_buckets=(16,), mesh=mesh))
+prompt = tok.encode("pack my box with five dozen")
+toks = [o.token_id for o in eng.generate(GenRequest(
+    list(prompt), SamplingParams(temperature=0.0), max_tokens=8,
+    ignore_eos=True))]
+json.dump(toks, open(out_path, "w"))
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def _env():
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def test_two_process_engine_matches_single_process(tmp_path_factory):
+    """Greedy engine decode on a 2-host × 4-device distributed mesh must be
+    token-identical to the single-process 8-device mesh run."""
+    ckpt = tiny_checkpoint(tmp_path_factory)
+    tmp = tmp_path_factory.mktemp("dist")
+    coord, rep = _free_port(), _free_port()
+
+    single_out = str(tmp / "single.json")
+    r = subprocess.run([sys.executable, "-c", _SINGLE_SCRIPT, ckpt,
+                        single_out],
+                       env=_env(), capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+    expect = json.load(open(single_out))
+    assert len(expect) == 8
+
+    dist_out = str(tmp / "dist.json")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _RANK_SCRIPT, str(rank), "2", str(coord),
+             str(rep), ckpt, dist_out],
+            env=_env(), stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        for rank in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+    finally:
+        for p in procs:
+            p.kill()
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank}:\n{out[-3000:]}"
+        assert f"RANK_{rank}_DONE" in out
+    got = json.load(open(dist_out))
+    assert got == expect
