@@ -29,26 +29,44 @@ _state = threading.local()
 class MeshConfig:
     """Mesh shape knobs (YAML `tensor_parallel` etc. map here).
 
-    data × model must equal the device count; axes of size 1 are fine.
+    data × model × seq must equal the device count; axes of size 1 are fine.
+    seq > 1 adds a third 'seq' axis for ring-attention sequence parallelism
+    (parallel/ring_attention.py) — long-prompt prefill shards the sequence
+    over it.
     """
     data: int = 1
     model: int = 1
+    seq: int = 1
 
     def axis_sizes(self) -> tuple[int, int]:
         return self.data, self.model
 
 
 def build_mesh(cfg: MeshConfig | None = None, devices=None) -> Mesh:
-    """Build a ('data','model') mesh. Defaults to all devices on the model axis
-    (tensor parallelism), the common single-host serving layout."""
+    """Build a ('data','model'[,'seq']) mesh. Defaults to all devices on the
+    model axis (tensor parallelism), the common single-host serving layout.
+    The 'seq' axis only exists when seq > 1, so existing 2-axis PartitionSpecs
+    stay valid."""
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
     if cfg is None:
         cfg = MeshConfig(data=1, model=n)
     d, m = cfg.axis_sizes()
-    if d * m != n:
-        raise ValueError(f"mesh {d}x{m} != {n} devices")
+    s = getattr(cfg, "seq", 1) or 1
+    if d * m * s != n:
+        raise ValueError(f"mesh {d}x{m}" + (f"x{s}" if s > 1 else "")
+                         + f" != {n} devices")
+    if s > 1:
+        return Mesh(np.array(devices).reshape(d, m, s),
+                    ("data", "model", "seq"))
     return Mesh(np.array(devices).reshape(d, m), ("data", "model"))
+
+
+def seq_axis_size(mesh: Mesh | None) -> int:
+    """Size of the ring-attention 'seq' axis (1 when absent/no mesh)."""
+    if mesh is None or "seq" not in mesh.axis_names:
+        return 1
+    return mesh.shape["seq"]
 
 
 def current_mesh() -> Mesh | None:

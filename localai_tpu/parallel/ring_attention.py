@@ -79,17 +79,22 @@ def ring_prefill(q, k, v, lengths, mesh: Mesh, axis: str = "seq",
     """Sequence-parallel causal GQA attention.
 
     q: [B, S, H, D]; k/v: [B, S, KVH, D]; lengths: [B]. S must divide by the
-    `axis` mesh size. Returns [B, S, H, D] sharded like q.
+    `axis` mesh size. Returns [B, S, H, D] sharded like q. On a combined
+    serving mesh ('data','model','seq') the batch/head axes keep their TP/DP
+    sharding — the ring runs over `axis` only, with data/model as ordinary
+    shard_map axes (the per-device body sees local B/H/KVH sizes).
     """
     d = q.shape[-1]
     scale = d ** -0.5
-    seq_sharding = P(None, axis, None, None)
+    data_ax = "data" if "data" in mesh.axis_names else None
+    model_ax = "model" if "model" in mesh.axis_names else None
+    qkv_spec = P(data_ax, axis, model_ax, None)
     fn = shard_map(
         functools.partial(_ring_attn_shard, axis_name=axis, scale=scale,
                           sliding_window=sliding_window),
         mesh=mesh,
-        in_specs=(seq_sharding, seq_sharding, seq_sharding, P(None)),
-        out_specs=seq_sharding,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, P(data_ax)),
+        out_specs=qkv_spec,
     )
     return fn(q, k, v, lengths)
 

@@ -131,3 +131,28 @@ def test_engine_on_mesh_matches_unmeshed():
         return [o.token_id for o in eng.generate(req())]
 
     assert run(None) == run(mesh)
+
+
+def test_engine_seq_parallel_matches_unmeshed():
+    """Ring-attention serving integration: an engine on a ('data','model',
+    'seq') mesh (sequence-parallel prefill over the ppermute ring) must
+    reproduce the no-mesh engine token-for-token."""
+    from localai_tpu.engine import Engine, EngineConfig, GenRequest
+    from localai_tpu.ops.sampling import SamplingParams
+
+    ps = init_params(CFG, jax.random.PRNGKey(5))
+    mesh = build_mesh(MeshConfig(data=1, model=2, seq=4))
+    assert mesh.axis_names == ("data", "model", "seq")
+    prompt = [5, 9, 2, 7, 11, 3]
+    req = lambda: GenRequest(prompt_ids=list(prompt),
+                             params=SamplingParams(temperature=0.0),
+                             max_tokens=8, ignore_eos=True)
+
+    def run(mesh_arg):
+        ec = EngineConfig(max_slots=2, max_context=64, prefill_buckets=(16,),
+                          mesh=mesh_arg)
+        eng = Engine(CFG, ps if mesh_arg is None else
+                     shard_params(ps, param_specs(CFG), mesh_arg), None, ec)
+        return [o.token_id for o in eng.generate(req())]
+
+    assert run(None) == run(mesh)
