@@ -26,6 +26,7 @@ class LLMServicer(BackendServicer):
         reports already-loaded instead of constructing a second engine."""
         self.engine = None
         self.embedder = None
+        self.scorer = None
         self.tok = None
         self.cfg = None
         self.model_name = ""
@@ -76,8 +77,12 @@ class LLMServicer(BackendServicer):
             model = request.mesh_model or (len(devices) // data)
             mesh = build_mesh(MeshConfig(data=data, model=model),
                               devices[: data * model])
-        elif len(devices) > 1 and request.dtype not in ("int8", "q8"):
+        elif (len(devices) > 1
+              and request.dtype not in ("int8", "q8", "int4", "q4")
+              and not request.draft_model):
             # auto-TP over as many devices as the model dims divide into
+            # (draft-model serving is single-device for now — the engine
+            # rejects a draft under a mesh)
             model = max_model_axis(cfg, len(devices))
             if model > 1:
                 mesh = build_mesh(MeshConfig(data=1, model=model),
@@ -93,15 +98,28 @@ class LLMServicer(BackendServicer):
         buckets = tuple(request.prefill_buckets) or tuple(
             b for b in (64, 256, 512) if b <= chunk
         ) or (chunk,)
+        draft = None
+        if request.draft_model:
+            # speculative decoding (reference DraftModel, backend.proto:218)
+            draft_dir = request.draft_model
+            if request.model_path and not os.path.isdir(draft_dir):
+                draft_dir = os.path.join(request.model_path, draft_dir)
+            dcfg = load_config(draft_dir, dtype=request.dtype or None)
+            draft = (dcfg, load_params(draft_dir, dcfg,
+                                       dtype=request.dtype or None))
         self.engine = Engine(cfg, params, tok, EngineConfig(
             max_slots=request.parallel or 4,
             max_context=context_size,
             prefill_buckets=buckets,
             prefill_chunk=chunk,
             mesh=mesh,
-        ))
+            gamma=request.n_draft or 4,
+        ), draft=draft)
         if request.embeddings:
+            from localai_tpu.engine.embedder import CrossScorer
+
             self.embedder = Embedder(cfg, params, buckets=buckets, mesh=mesh)
+            self.scorer = CrossScorer(cfg, params, buckets=buckets, mesh=mesh)
         self.cfg, self.tok = cfg, tok
         self.model_name = request.model
         self.engine.start()
@@ -246,23 +264,23 @@ class LLMServicer(BackendServicer):
                                   prompt_tokens=len(ids))
 
     def Rerank(self, request, context):
-        """Embedding-similarity rerank (reference Rerank RPC,
-        grpc-server.cpp:1466 / rerankers backend). Scores are cosine
-        similarity between pooled query/document embeddings."""
-        if self.embedder is None:
+        """Cross-encoder rerank (reference Rerank RPC, grpc-server.cpp:1466 /
+        rerankers backend): each document scored by the LM's conditional
+        log-likelihood given the query — query+document attend jointly
+        (engine/embedder.py CrossScorer), not bi-encoder cosine."""
+        if self.scorer is None:
             context.abort(grpc.StatusCode.FAILED_PRECONDITION,
                           "model loaded without embeddings=true")
         if not request.query or not request.documents:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT,
                           "query and documents required")
-        ids = [self.tok.encode(request.query)] + [
-            self.tok.encode(d) for d in request.documents
-        ]
+        q_ids = self.tok.encode(request.query)
+        d_ids = [self.tok.encode(d, add_bos=False)
+                 for d in request.documents]
         try:
-            vecs = self.embedder.embed(ids)
+            sims = self.scorer.score(q_ids, d_ids)
         except ValueError as e:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
-        sims = vecs[1:] @ vecs[0]
         order = sims.argsort()[::-1]
         top_n = request.top_n or len(order)
         resp = pb.RerankResult()

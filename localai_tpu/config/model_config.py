@@ -86,6 +86,8 @@ class ModelConfig:
     prefill_buckets: list[int] = dataclasses.field(default_factory=list)
     mesh: MeshShape = dataclasses.field(default_factory=MeshShape)
     grammar: str = ""
+    draft_model: str = ""            # speculative decoding draft checkpoint
+    n_draft: int = 0                 # draft tokens per step (0 = default 4)
     pipeline: Pipeline = dataclasses.field(default_factory=Pipeline)
     known_usecases: list[str] = dataclasses.field(default_factory=list)
     # file this config came from (set by the loader)

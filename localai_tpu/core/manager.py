@@ -113,6 +113,10 @@ class ModelManager:
             mesh_data=m.mesh.data,
             mesh_model=m.mesh.model,
             embeddings=m.embeddings or m.backend == "embedding",
+            draft_model=(m.draft_model if not m.draft_model
+                         or os.path.isabs(m.draft_model)
+                         else os.path.join(cfg.models_path, m.draft_model)),
+            n_draft=m.n_draft,
         )
         if not r.success:
             raise RuntimeError(f"LoadModel({m.name}) failed: {r.message}")

@@ -68,6 +68,8 @@ class EngineConfig:
     shift_keep: int = 4           # context-shift: sink tokens always kept
     replicator: Any | None = None  # multi-host: rank-0 step broadcaster
                                    # (parallel/distributed.Replicator)
+    gamma: int = 4                # speculative: draft tokens per step
+                                  # (reference NDraft, backend.proto:150)
 
 
 @dataclasses.dataclass
@@ -127,11 +129,19 @@ class Engine:
         params,
         tokenizer=None,
         econfig: EngineConfig | None = None,
+        draft: tuple | None = None,
     ):
+        """`draft=(draft_cfg, draft_params)` enables speculative decoding:
+        the engine proposes ec.gamma tokens per step with the draft model and
+        verifies them in one target forward (engine/spec.py)."""
         self.cfg = cfg
         self.params = params
         self.tok = tokenizer
         self.ec = econfig or EngineConfig()
+        self._draft = draft
+        if draft is not None and self.ec.mesh is not None:
+            raise NotImplementedError(
+                "draft model under a mesh is not supported yet")
         if self.ec.max_context > cfg.max_position:
             raise ValueError("max_context exceeds model max_position")
         for b in self.ec.prefill_buckets:
@@ -149,6 +159,16 @@ class Engine:
             self._sampler = SamplerState.init(B, V)
             self._last_logits = jnp.zeros((B, V), jnp.float32)
             self._lengths = jnp.zeros((B,), jnp.int32)
+            if self._draft is not None:
+                dcfg = self._draft[0]
+                if dcfg.vocab_size != V:
+                    raise ValueError("draft vocab differs from target")
+                self._cos_d, self._sin_d = rope_table(dcfg.rope, T)
+                self._kcd, self._vcd = init_kv_cache(dcfg, B, T, dtype)
+                self._next_tokens = jnp.zeros((B,), jnp.int32)
+        # window the verify extend writes ahead of `lengths`; reserve it so
+        # a spec step can never write past the cache end
+        self._ctx_reserve = (self.ec.gamma + 1) if self._draft else 0
 
         # grammar masks: one bitmask row per slot, all-ones = unconstrained
         self._mask_nbytes = (V + 7) // 8
@@ -196,6 +216,9 @@ class Engine:
             "ttft_ms_last": 0.0,
             "tokens_per_second_last": 0.0,
         }
+        if self._draft is not None:
+            self.metrics["draft_proposed"] = 0
+            self.metrics["draft_accepted"] = 0
 
         self._build_jit()
 
@@ -291,6 +314,20 @@ class Engine:
             partial(cache_shift, cfg, keep=self.ec.shift_keep,
                     discard=self._shift_discard),
             donate_argnums=(0, 1, 2))
+
+        if self._draft is not None:
+            from localai_tpu.engine.spec import (
+                build_draft_ingest, build_spec_admit_tail, build_spec_decode,
+            )
+
+            dcfg = self._draft[0]
+            self._spec_fn = jax.jit(
+                build_spec_decode(cfg, dcfg, self.ec.gamma),
+                donate_argnums=(6, 7, 8, 9, 10, 11, 12))
+            self._spec_admit_tail_fn = jax.jit(
+                build_spec_admit_tail(cfg), donate_argnums=(0,))
+            self._draft_ingest_fn = jax.jit(
+                build_draft_ingest(dcfg), donate_argnums=(3, 4))
         self._decode_fn = jax.jit(_decode, donate_argnums=(3, 4, 5, 6, 7),
                                   static_argnames=())
         self._decode_nomask_fn = jax.jit(
@@ -369,6 +406,30 @@ class Engine:
             self._kc, self._vc, self._lengths = self._shift_fn(
                 self._kc, self._vc, self._lengths, jnp.int32(idx))
 
+    def _dev_draft_ingest(self, buf, pos, idx):
+        self._bcast("draft_ingest", buf=buf, pos=pos, idx=idx)
+        self._kcd, self._vcd = self._draft_ingest_fn(
+            self._draft[1], self._cos_d, self._sin_d, self._kcd, self._vcd,
+            jnp.asarray(buf), jnp.int32(pos), jnp.int32(idx))
+
+    def _dev_spec_admit_tail(self, idx):
+        self._bcast("spec_admit_tail", idx=idx)
+        tok, lp, self._sampler = self._spec_admit_tail_fn(
+            self._sampler, self._last_logits, jnp.int32(idx))
+        self._next_tokens = self._next_tokens.at[idx].set(tok)
+        return int(tok), float(lp)
+
+    def _dev_spec_decode(self, active):
+        self._bcast("spec", active=active)
+        (tokens_out, n_out, logprobs_out, self._next_tokens,
+         self._kc, self._vc, self._kcd, self._vcd, self._sampler,
+         self._lengths, n_extra) = self._spec_fn(
+            self.params, self._draft[1], self._cos, self._sin,
+            self._cos_d, self._sin_d, self._kc, self._vc,
+            self._kcd, self._vcd, self._sampler, self._lengths,
+            self._next_tokens, jnp.asarray(active))
+        return tokens_out, n_out, logprobs_out, n_extra
+
     def follow(self, channel) -> None:
         """Follower-rank loop (multi-host, process_index > 0): replay the
         rank-0 engine's device dispatches against this process's shards of
@@ -393,6 +454,12 @@ class Engine:
                 self._dev_decode(kw["active"], kw["mask"])
             elif op == "shift":
                 self._dev_shift(kw["idx"])
+            elif op == "draft_ingest":
+                self._dev_draft_ingest(kw["buf"], kw["pos"], kw["idx"])
+            elif op == "spec_admit_tail":
+                self._dev_spec_admit_tail(kw["idx"])
+            elif op == "spec":
+                self._dev_spec_decode(kw["active"])
 
     # ------------------------------------------------------------ submission
 
@@ -402,12 +469,21 @@ class Engine:
             raise RuntimeError("engine loop has terminated; no new requests")
         if len(req.prompt_ids) == 0:
             raise ValueError("empty prompt")
-        if len(req.prompt_ids) > self.ec.max_context - 2:
+        limit = self.ec.max_context - 2 - self._ctx_reserve
+        if len(req.prompt_ids) > limit:
             raise ValueError(
-                f"prompt length {len(req.prompt_ids)} exceeds max_context-2 "
-                f"({self.ec.max_context - 2}); longer prompts need a larger "
-                f"context window"
+                f"prompt length {len(req.prompt_ids)} exceeds {limit} "
+                f"(max_context minus the decode margin); longer prompts "
+                f"need a larger context window"
             )
+        if req.grammar and self._draft is not None:
+            raise ValueError(
+                "grammar-constrained decoding is not supported with a "
+                "draft model (the grammar mask must advance per token)")
+        if req.context_shift and self._draft is not None:
+            raise ValueError(
+                "context_shift is not supported with a draft model "
+                "(the draft cache would need shifting too)")
         V = self.cfg.vocab_size
         if any(not (0 <= t < V) for t in req.prompt_ids):
             raise ValueError(f"prompt token id outside [0, {V})")
@@ -476,14 +552,17 @@ class Engine:
             ids = np.zeros((1, bucket), np.int32)
             ids[0, :n] = req.prompt_ids
             self._dev_admit(ids, n, slot, row, counts_row)
+            if self._draft is not None:
+                self._dev_draft_ingest(ids, 0, slot)
 
-        self._slots[slot] = _Slot(
+        slot_obj = _Slot(
             request_id=rid, req=req, out=out,
             detok=self.tok.stream_decoder() if self.tok else None,
             matcher=matcher,
             start_time=time.monotonic(), prompt_len=n,
             prefilled=not chunked, row=row, counts_row=counts_row,
         )
+        self._slots[slot] = slot_obj
         if chunked:
             self._prefillq.append(slot)
         if matcher is not None:
@@ -491,6 +570,11 @@ class Engine:
             self._mask_host[slot] = matcher.mask_bits(eos)
             self._grammar_slots += 1
         self.metrics["prompt_tokens_processed"] += n
+        if not chunked and self._draft is not None:
+            # spec invariant: the first token is sampled (and emitted) at
+            # admission; it becomes the carried next_token
+            tok, lp = self._dev_spec_admit_tail(slot)
+            self._emit(slot, slot_obj, tok, lp, time.monotonic())
         return True
 
     def _prefill_tick(self):
@@ -513,10 +597,15 @@ class Engine:
                                        slot.counts_row)
             else:
                 self._dev_extend_mid(buf, pos, idx)
+            if self._draft is not None:
+                self._dev_draft_ingest(buf, pos, idx)
             slot.prefill_pos = pos + nvalid
             if final:
                 slot.prefilled = True
                 self._prefillq.pop(0)
+                if self._draft is not None:
+                    tok, lp = self._dev_spec_admit_tail(idx)
+                    self._emit(idx, slot, tok, lp, time.monotonic())
             return
         if not self._free:
             return
@@ -557,6 +646,36 @@ class Engine:
                 continue
             self._emit(i, slot, int(tokens[i]), float(logprobs[i]), now)
 
+    def _step_spec(self) -> bool:
+        """Spec-mode iteration: one batched draft+verify step for all active
+        slots (engine/spec.py), emitting 1..gamma+1 tokens per slot."""
+        active = self._active_mask()
+        if active.any():
+            entries = [(int(i), self._slots[i].request_id)
+                       for i in np.where(active)[0]]
+            pend = self._dev_spec_decode(active)
+            self._prefill_tick()   # admission overlaps the device step
+            tokens_out, n_out, logprobs_out, n_extra = (
+                np.asarray(jax.device_get(x)) for x in pend)
+            now = time.monotonic()
+            G = self.ec.gamma
+            for i, rid in entries:
+                slot = self._slots[i]
+                if slot is None or slot.request_id != rid:
+                    continue
+                self.metrics["draft_proposed"] += G
+                self.metrics["draft_accepted"] += int(n_extra[i])
+                for j in range(int(n_out[i])):
+                    slot = self._slots[i]
+                    if slot is None or slot.request_id != rid:
+                        break  # finished mid-window (EOS/length/stop)
+                    self._emit(i, slot, int(tokens_out[i, j]),
+                               float(logprobs_out[i, j]), now)
+        else:
+            self._prefill_tick()
+        return (any(s is not None for s in self._slots)
+                or not self._queue.empty())
+
     def step(self) -> bool:
         """One engine iteration. In pipelined mode (the default, grammar-free)
         one decode step stays in flight: step N+1 is dispatched before step
@@ -564,6 +683,8 @@ class Engine:
         Python bookkeeping behind the next step's compute. Grammar-constrained
         batches run synchronously (the sampled token must update the PDA mask
         before the next sample). Returns True while work remains."""
+        if self._draft is not None:
+            return self._step_spec()
         sync = self._grammar_slots > 0 or not self.ec.pipeline
         if sync and self._pending is not None:
             self._consume(self._pending)
@@ -599,11 +720,12 @@ class Engine:
             finish = "eos"
         elif slot.generated >= slot.req.max_tokens:
             finish = "length"
-        elif cache_len >= self.ec.max_context - 2:
+        elif cache_len >= self.ec.max_context - 2 - self._ctx_reserve:
             if slot.req.context_shift:
                 # evict-and-continue (reference ctx_shift): slide the cache
                 # left, re-rotating K; the in-flight pipelined step wrote at a
                 # pre-shift position and is already part of the device state
+                # (spec mode rejected context_shift at submit)
                 self._dev_shift(idx)
                 slot.shifted += self._shift_discard
             else:

@@ -128,15 +128,10 @@ def apply_penalties(logits, state: SamplerState):
     return logits
 
 
-def sample(logits, state: SamplerState, mask_bits=None):
-    """One sampling step. logits: [B, V] (any float dtype).
-
-    mask_bits: optional [B, ceil(V/8)] u8 allowed-token bitmask (LSB-first)
-    from the grammar matcher — disallowed tokens are hard-masked before the
-    truncation chain (the llama.cpp grammar-sampler role, applied on-device).
-
-    Returns (tokens [B] i32, new_keys [B,2], logprobs [B] f32 of chosen token).
-    """
+def pipeline_logits(logits, state: SamplerState, mask_bits=None):
+    """Penalties → bias → temperature (the pre-truncation transform). The
+    log_softmax of this is sample()'s logprob contract — OpenAI-style
+    logprobs are NOT inflated by top-k/top-p renormalization."""
     b, v = logits.shape
     logits = logits.astype(jnp.float32)
     if mask_bits is not None:
@@ -145,7 +140,15 @@ def sample(logits, state: SamplerState, mask_bits=None):
         logits = jnp.where(allowed, logits, NEG_INF)
     logits = apply_penalties(logits, state)
     logits = logits + state.logit_bias
-    logits = logits / jnp.maximum(state.temperature[:, None], 1e-6)
+    return logits / jnp.maximum(state.temperature[:, None], 1e-6)
+
+
+def _filtered_sorted(logits, state: SamplerState, mask_bits=None):
+    """Shared pipeline: penalties → bias → temperature → truncation chain.
+    Returns (masked_sorted_logits [B,V] desc with dropped entries at NEG_INF,
+    order [B,V] mapping sorted rank → token id)."""
+    b, v = logits.shape
+    logits = pipeline_logits(logits, state, mask_bits)
 
     # shared descending sort powers top-k / top-p / min-p / typical-p
     sorted_logits = -jnp.sort(-logits, axis=-1)                 # [B,V] desc
@@ -175,6 +178,35 @@ def sample(logits, state: SamplerState, mask_bits=None):
     keep = keep.at[:, 0].set(True)
 
     masked = jnp.where(keep, sorted_logits, NEG_INF)
+    return masked, sorted_logits, order
+
+
+def sampling_probs(logits, state: SamplerState, mask_bits=None):
+    """Full post-pipeline categorical distribution [B, V] in TOKEN order —
+    exactly what sample() draws from (greedy rows → one-hot argmax). The
+    speculative verifier needs this as an explicit density (Leviathan accept
+    ratio + residual distribution)."""
+    b, v = logits.shape
+    masked, _, order = _filtered_sorted(logits, state, mask_bits)
+    p_sorted = jax.nn.softmax(masked, axis=-1)
+    rank0 = (jnp.arange(v)[None, :] == 0).astype(jnp.float32)
+    p_sorted = jnp.where(state.greedy[:, None], rank0, p_sorted)
+    return jnp.zeros((b, v), jnp.float32).at[
+        jnp.arange(b)[:, None], order
+    ].set(p_sorted)
+
+
+def sample(logits, state: SamplerState, mask_bits=None):
+    """One sampling step. logits: [B, V] (any float dtype).
+
+    mask_bits: optional [B, ceil(V/8)] u8 allowed-token bitmask (LSB-first)
+    from the grammar matcher — disallowed tokens are hard-masked before the
+    truncation chain (the llama.cpp grammar-sampler role, applied on-device).
+
+    Returns (tokens [B] i32, new_keys [B,2], logprobs [B] f32 of chosen token).
+    """
+    b, v = logits.shape
+    masked, sorted_logits, order = _filtered_sorted(logits, state, mask_bits)
     new_keys = jax.vmap(lambda kk: jax.random.split(jax.random.wrap_key_data(kk), 2))(
         state.key
     )
