@@ -56,3 +56,65 @@ class Embedder:
             out = self._fn(self.params, tokens=jnp.asarray(toks),
                            lengths=jnp.asarray(lens))
         return np.asarray(jax.device_get(out))[:n]
+
+
+def _doc_logprob(params, cfg, tokens, lengths, q_len):
+    """Mean conditional log-prob of the document tokens given the query
+    prefix. tokens [B, S]; lengths [B] total (query+doc); q_len [B]."""
+    from localai_tpu.models.llama import forward_train
+
+    logits = forward_train(params, cfg, tokens)            # [B, S, V]
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    b, s = tokens.shape
+    # position i's logits predict token i+1
+    tok_lp = jnp.take_along_axis(
+        lp[:, :-1], tokens[:, 1:, None], axis=-1)[..., 0]  # [B, S-1]
+    pos = jnp.arange(s - 1)[None, :]
+    mask = (pos + 1 >= q_len[:, None]) & (pos + 1 < lengths[:, None])
+    n_doc = jnp.maximum(mask.sum(axis=1), 1)
+    return (tok_lp * mask).sum(axis=1) / n_doc
+
+
+class CrossScorer:
+    """Cross-encoder-style reranker over the causal LM: each document is
+    scored by the model's mean log-likelihood of the document tokens
+    CONDITIONED on the query — query and document attend jointly, which is
+    what makes it a cross-encoder rather than a bi-encoder cosine
+    (reference role: the rerankers backend,
+    /root/reference/backend/python/rerankers/backend.py)."""
+
+    def __init__(self, cfg: LlamaConfig, params, *,
+                 buckets: tuple[int, ...] = (64, 256, 1024), mesh=None):
+        self.cfg = cfg
+        self.params = params
+        self.buckets = tuple(sorted(b for b in buckets
+                                    if b <= cfg.max_position)) or (64,)
+        self.mesh = mesh
+        self._fn = jax.jit(partial(_doc_logprob, cfg=cfg))
+
+    def score(self, query_ids: list[int],
+              docs_ids: list[list[int]]) -> np.ndarray:
+        """[N] relevance scores (higher = more relevant)."""
+        if not docs_ids:
+            return np.zeros((0,), np.float32)
+        pairs = [list(query_ids) + list(d) for d in docs_ids]
+        longest = max(len(p) for p in pairs)
+        bucket = next((b for b in self.buckets if longest <= b), None)
+        if bucket is None:
+            raise ValueError(
+                f"query+document length {longest} exceeds max bucket "
+                f"{self.buckets[-1]}")
+        n = len(pairs)
+        nb = 1
+        while nb < n:
+            nb *= 2
+        toks = np.zeros((nb, bucket), np.int32)
+        lens = np.zeros((nb,), np.int32)
+        for i, p in enumerate(pairs):
+            toks[i, :len(p)] = p
+            lens[i] = len(p)
+        qlen = np.full((nb,), len(query_ids), np.int32)
+        with activate_mesh(self.mesh):
+            out = self._fn(self.params, tokens=jnp.asarray(toks),
+                           lengths=jnp.asarray(lens), q_len=jnp.asarray(qlen))
+        return np.asarray(jax.device_get(out))[:n]

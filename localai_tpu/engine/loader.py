@@ -62,7 +62,8 @@ def load_config(model_dir: str, dtype: str | None = None) -> LlamaConfig:
     )
     if dtype is not None:
         # int8 = weight quantization; activations/KV stay bf16
-        kw["dtype"] = "bfloat16" if dtype in ("int8", "q8") else dtype
+        kw["dtype"] = ("bfloat16" if dtype in ("int8", "q8", "int4", "q4")
+                       else dtype)
 
     rs = hf.get("rope_scaling") or hf.get("rope_parameters") or None
     if rs and isinstance(rs, dict) and rs.get("rope_type", rs.get("type")) not in (None, "default"):
@@ -181,14 +182,16 @@ def load_params(
     (models/llama.py init_params). With `mesh`, each stacked param is placed
     as a NamedSharding'ed jax.Array per param_specs (Megatron-style TP).
 
-    dtype="int8" loads bf16 then quantizes projections per output channel
-    (ops/quant.quantize_params — the GGUF-quant analog); currently a
-    single-chip path (param_specs doesn't cover the {q, s} leaves yet).
+    dtype="int8"/"int4" loads bf16 then quantizes projections per output
+    channel (ops/quant.quantize_params — the GGUF-quant analog, int4 being
+    the exllama2/Q4 role); currently a single-chip path (param_specs doesn't
+    cover the {q, s} leaves yet).
     """
-    quantize = dtype in ("int8", "q8")
+    qbits = {"int8": 8, "q8": 8, "int4": 4, "q4": 4}.get(dtype)
+    quantize = qbits is not None
     if quantize:
         if mesh is not None:
-            raise NotImplementedError("int8 quantization under a mesh")
+            raise NotImplementedError("weight quantization under a mesh")
         dtype = "bfloat16"
     dtype = jnp.dtype(dtype) if dtype is not None else cfg.jdtype
 
@@ -197,7 +200,7 @@ def load_params(
         # are deterministic random init on device — lets the serving path be
         # measured at flagship scale without writing tens of GB to disk
         return _synthetic_params(cfg, dtype=dtype, mesh=mesh,
-                                 quantize=quantize)
+                                 qbits=qbits)
 
     r = _TensorReader(model_dir)
     specs = param_specs(cfg) if mesh is not None else None
@@ -256,18 +259,18 @@ def load_params(
     if quantize:
         from localai_tpu.ops.quant import quantize_params
 
-        params = quantize_params(params)
+        params = quantize_params(params, bits=qbits)
     return params
 
 
-def _synthetic_params(cfg: LlamaConfig, *, dtype, mesh=None, quantize=False):
-    """Deterministic random params at any scale. The int8 case generates the
-    quantized {q, s} leaves DIRECTLY — an 8B bf16 intermediate would not fit
+def _synthetic_params(cfg: LlamaConfig, *, dtype, mesh=None, qbits=None):
+    """Deterministic random params at any scale. The quantized case generates
+    the {q, s} leaves DIRECTLY — an 8B bf16 intermediate would not fit
     next to itself on a 16GB chip."""
     from localai_tpu.models.llama import init_params
     from localai_tpu.parallel.mesh import shard_params
 
-    if not quantize:
+    if qbits is None:
         params = init_params(cfg, jax.random.PRNGKey(0), dtype=dtype)
         if mesh is not None:
             params = shard_params(params, param_specs(cfg), mesh)
@@ -277,13 +280,15 @@ def _synthetic_params(cfg: LlamaConfig, *, dtype, mesh=None, quantize=False):
     nh, nkv, L, inter = (cfg.num_heads, cfg.num_kv_heads, cfg.num_layers,
                          cfg.intermediate_size)
     key = jax.random.PRNGKey(0)
+    qmax = 7 if qbits == 4 else 127
+    qdtype = jnp.int4 if qbits == 4 else jnp.int8
 
     def qrand(k, shape, fan_in):
-        # int8 body + per-output-channel scale sized so dequantized weights
+        # int body + per-output-channel scale sized so dequantized weights
         # have ~1/sqrt(fan_in) std, matching init_params' distribution
-        q = jax.random.randint(k, shape, -127, 128, jnp.int8)
+        q = jax.random.randint(k, shape, -qmax, qmax + 1).astype(qdtype)
         s = jnp.full(shape[:-2] + (1, shape[-1]),
-                     (fan_in ** -0.5) / 73.0, jnp.float32)
+                     (fan_in ** -0.5) * (1.73 / qmax), jnp.float32)
         return {"q": q, "s": s}
 
     ks = jax.random.split(key, 10)

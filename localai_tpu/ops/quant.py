@@ -13,17 +13,24 @@ import jax
 import jax.numpy as jnp
 
 
-def quantize(w):
-    """f32/bf16 weight [..., in, out] → {"q": int8, "s": f32 [..., 1, out]}.
+def quantize(w, bits: int = 8):
+    """f32/bf16 weight [..., in, out] → {"q": int8|int4, "s": f32 [..., 1, out]}.
 
     Scales reduce over the INPUT axis only: leading dims (the stacked layer
     axis of the scan layout) keep their own scales — reducing them away
     would give every layer one shared scale AND break lax.scan's leading-axis
-    agreement between q [L, in, out] and s."""
+    agreement between q [L, in, out] and s.
+
+    bits=4 stores jnp.int4 (the exllama2/GGUF-Q4 role — half the HBM traffic
+    of int8 again; XLA packs two nibbles per byte)."""
+    if bits not in (4, 8):
+        raise ValueError(f"unsupported quantization width {bits}")
+    qmax = 7 if bits == 4 else 127
+    qdtype = jnp.int4 if bits == 4 else jnp.int8
     w32 = jnp.asarray(w, jnp.float32)
     amax = jnp.max(jnp.abs(w32), axis=-2, keepdims=True)
-    scale = jnp.maximum(amax, 1e-8) / 127.0
-    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+    scale = jnp.maximum(amax, 1e-8) / qmax
+    q = jnp.clip(jnp.round(w32 / scale), -qmax, qmax).astype(qdtype)
     return {"q": q, "s": scale.astype(jnp.float32)}
 
 
@@ -45,18 +52,18 @@ def qmatmul(x, p):
     return y * p["s"].reshape((1,) * (y.ndim - 1) + (-1,)).astype(y.dtype)
 
 
-def quantize_params(params, *, skip=("embed", "final_norm")):
+def quantize_params(params, *, bits: int = 8, skip=("embed", "final_norm")):
     """Quantize every projection matrix in a llama param tree (norms, biases
     and embeddings stay high-precision, like llama.cpp's mixed layouts)."""
     out = {}
     for k, v in params.items():
         if k == "layers":
             out[k] = {
-                lk: (quantize(lv) if lk.startswith("w") else lv)
+                lk: (quantize(lv, bits) if lk.startswith("w") else lv)
                 for lk, lv in v.items()
             }
         elif k == "lm_head":
-            out[k] = quantize(v)
+            out[k] = quantize(v, bits)
         else:
             out[k] = v
     return out
