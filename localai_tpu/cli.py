@@ -52,6 +52,98 @@ def _add_federated(sub):
     return p
 
 
+def _add_tts(sub):
+    p = sub.add_parser("tts", help="synthesize speech to a WAV file "
+                                   "(reference core/cli/tts.go)")
+    p.add_argument("text", help="text to speak")
+    p.add_argument("--model", default="default-tts")
+    p.add_argument("--voice", default="")
+    p.add_argument("--language", default="")
+    p.add_argument("--output-file", default="output.wav")
+    p.add_argument("--models-path", default="models")
+    return p
+
+
+def _add_transcript(sub):
+    p = sub.add_parser("transcript",
+                       help="transcribe an audio file "
+                            "(reference core/cli/transcript.go)")
+    p.add_argument("filename", help="audio file (16kHz WAV)")
+    p.add_argument("--model", default="default-whisper")
+    p.add_argument("--language", default="")
+    p.add_argument("--translate", action="store_true")
+    p.add_argument("--output-format", default="text",
+                   choices=["text", "json", "srt"])
+    p.add_argument("--models-path", default="models")
+    return p
+
+
+def _one_shot_handle(model: str, models_path: str, default_backend: str):
+    """Spawn the backend for a one-shot CLI inference command."""
+    from localai_tpu.config import AppConfig, ModelConfig, ModelConfigLoader
+    from localai_tpu.core.manager import ModelManager
+
+    import dataclasses
+
+    app = AppConfig(models_path=models_path)
+    cfg = ModelConfigLoader(models_path).get(model) if model else None
+    if cfg is None:
+        cfg = ModelConfig(name=model, backend=default_backend)
+    elif not cfg.config_file and cfg.backend == "llm":
+        # bare checkpoint dir auto-registered with the generic default —
+        # this one-shot command knows the right backend role
+        cfg = dataclasses.replace(cfg, backend=default_backend)
+    manager = ModelManager(app)
+    return manager, manager.load(cfg)
+
+
+def cli_tts(args) -> int:
+    manager, handle = _one_shot_handle(args.model, args.models_path, "tts")
+    try:
+        import os
+
+        dst = os.path.abspath(args.output_file)
+        r = handle.client.tts(text=args.text, voice=args.voice, dst=dst,
+                              language=args.language)
+        if not r.success:
+            print(f"tts failed: {r.message}")
+            return 1
+        print(dst)
+        return 0
+    finally:
+        manager.stop_all()
+
+
+def cli_transcript(args) -> int:
+    import json as _json
+    import os
+
+    manager, handle = _one_shot_handle(args.model, args.models_path,
+                                       "whisper")
+    try:
+        r = handle.client.transcribe(dst=os.path.abspath(args.filename),
+                                     language=args.language,
+                                     translate=args.translate)
+        if args.output_format == "json":
+            print(_json.dumps({"text": r.text, "segments": [
+                {"id": s.id, "start": s.start / 1e9, "end": s.end / 1e9,
+                 "text": s.text} for s in r.segments]}))
+        elif args.output_format == "srt":
+            def ts(ns):
+                s, ms = divmod(int(ns // 1e6), 1000)
+                h, rem = divmod(s, 3600)
+                m, s = divmod(rem, 60)
+                return f"{h:02}:{m:02}:{s:02},{ms:03}"
+
+            for i, seg in enumerate(r.segments, 1):
+                print(f"{i}\n{ts(seg.start)} --> {ts(seg.end)}\n{seg.text}\n")
+        else:
+            print(r.text)
+        return 0
+    finally:
+        manager.stop_all()
+
+
 def _add_worker(sub):
     p = sub.add_parser(
         "worker",
@@ -93,6 +185,8 @@ def main(argv=None):
     _add_models(sub)
     _add_federated(sub)
     _add_worker(sub)
+    _add_tts(sub)
+    _add_transcript(sub)
     sub.add_parser("version", help="print version")
 
     args = parser.parse_args(argv)
@@ -122,6 +216,10 @@ def main(argv=None):
         from localai_tpu.core.worker import run_worker
 
         return run_worker(args)
+    if cmd == "tts":
+        return cli_tts(args)
+    if cmd == "transcript":
+        return cli_transcript(args)
     if cmd == "run":
         from localai_tpu.server.http import run_server
 

@@ -89,6 +89,12 @@ class API:
         r.add_post("/models/apply", self._models_apply)
         r.add_get("/models/available", self._models_available)
         r.add_get("/models/jobs/{job_id}", self._models_job)
+        # WebUI (reference routes/ui.go role) + API-compat route families
+        r.add_get("/", self._webui)
+        r.add_get("/chat", self._webui)
+        # elevenlabs compat (reference routes/elevenlabs.go)
+        r.add_post("/v1/text-to-speech/{voice_id}", self._elevenlabs_tts)
+        r.add_post("/v1/sound-generation", self._sound_generation)
         self.gallery_service = None  # wired by run_server when galleries set
 
     # ------------------------------------------------------------ middleware
@@ -587,13 +593,12 @@ class API:
 
             _os.unlink(path)
 
-    async def _speech(self, request):
-        """OpenAI /v1/audio/speech + localai /tts → WAV bytes."""
+    async def _tts_wav(self, name: str, text: str, voice: str,
+                       language: str) -> web.Response:
+        """Shared one-shot TTS → WAV response (speech/tts/elevenlabs routes)."""
+        import os as _os
         import tempfile
 
-        body = await request.json()
-        text = body.get("input") or body.get("text") or ""
-        name = body.get("model") or "default-tts"
         cfg = self.configs.get(name)
         if cfg is None:
             cfg = ModelConfig(name=name, backend="tts")
@@ -602,17 +607,42 @@ class API:
             path = t.name
         handle.mark_busy()
         try:
-            await asyncio.to_thread(lambda: handle.client.tts(
-                text=text, voice=body.get("voice", ""), dst=path,
-                language=body.get("language", "")))
+            r = await asyncio.to_thread(lambda: handle.client.tts(
+                text=text, voice=voice, dst=path, language=language))
+            if not r.success:
+                raise web.HTTPInternalServerError(
+                    text=json.dumps(schema.error_body(
+                        f"tts failed: {r.message}", "server_error", 500)),
+                    content_type="application/json")
             with open(path, "rb") as f:
                 data = f.read()
             return web.Response(body=data, content_type="audio/wav")
         finally:
             handle.mark_idle()
-            import os as _os
-
             _os.unlink(path)
+
+    async def _speech(self, request):
+        """OpenAI /v1/audio/speech + localai /tts → WAV bytes."""
+        body = await request.json()
+        return await self._tts_wav(
+            body.get("model") or "default-tts",
+            body.get("input") or body.get("text") or "",
+            body.get("voice", ""), body.get("language", ""))
+
+    async def _webui(self, request):
+        from localai_tpu.server.webui import INDEX_HTML
+
+        return web.Response(text=INDEX_HTML, content_type="text/html")
+
+    async def _elevenlabs_tts(self, request):
+        """elevenlabs-shaped TTS: voice from the path, text in the body
+        (reference core/http/endpoints/elevenlabs/tts.go)."""
+        body = await request.json()
+        return await self._tts_wav(
+            body.get("model_id") or body.get("model") or "default-tts",
+            body.get("text") or "",
+            request.match_info.get("voice_id", ""),
+            body.get("language_code", ""))
 
     async def _vad(self, request):
         body = await request.json()

@@ -1,0 +1,153 @@
+"""Minimal built-in WebUI — the reference's chat UI role
+(/root/reference/core/http/routes/ui.go + views/chat.html), rebuilt as one
+dependency-free page: model picker from /v1/models, streaming chat over the
+/v1/chat/completions SSE surface, and a status strip from /backend/monitor.
+"""
+
+INDEX_HTML = """<!doctype html>
+<html lang="en">
+<head>
+<meta charset="utf-8">
+<meta name="viewport" content="width=device-width, initial-scale=1">
+<title>LocalAI-TPU</title>
+<style>
+  :root { --bg:#0f1117; --panel:#181b24; --line:#2a2f3d; --text:#e6e8ee;
+          --dim:#9aa1b2; --accent:#7aa2f7; --user:#1f2636; }
+  * { box-sizing: border-box; }
+  body { margin:0; background:var(--bg); color:var(--text);
+         font:15px/1.5 system-ui, sans-serif; display:flex;
+         flex-direction:column; height:100vh; }
+  header { display:flex; gap:12px; align-items:center; padding:10px 16px;
+           background:var(--panel); border-bottom:1px solid var(--line); }
+  header h1 { font-size:15px; margin:0; font-weight:600; }
+  header h1 span { color:var(--accent); }
+  select, button, textarea {
+    background:var(--bg); color:var(--text); border:1px solid var(--line);
+    border-radius:8px; font:inherit; }
+  select { padding:6px 8px; }
+  #status { margin-left:auto; color:var(--dim); font-size:12px; }
+  #log { flex:1; overflow-y:auto; padding:16px; max-width:860px; width:100%;
+         margin:0 auto; }
+  .msg { padding:10px 14px; border-radius:10px; margin:8px 0;
+         white-space:pre-wrap; word-break:break-word; }
+  .user { background:var(--user); margin-left:15%; }
+  .assistant { background:var(--panel); margin-right:15%;
+               border:1px solid var(--line); }
+  .meta { color:var(--dim); font-size:11px; margin:2px 6px; }
+  form { display:flex; gap:8px; padding:12px 16px; max-width:860px;
+         width:100%; margin:0 auto; }
+  textarea { flex:1; resize:none; padding:10px; height:48px; }
+  button { padding:0 18px; cursor:pointer; }
+  button.primary { background:var(--accent); color:#0b0d12; border:none;
+                   font-weight:600; }
+</style>
+</head>
+<body>
+<header>
+  <h1>Local<span>AI</span>-TPU</h1>
+  <select id="model"></select>
+  <button id="clear" title="clear conversation">Clear</button>
+  <div id="status"></div>
+</header>
+<div id="log"></div>
+<form id="f">
+  <textarea id="inp" placeholder="Send a message… (Enter to send, Shift+Enter for newline)"></textarea>
+  <button class="primary" type="submit" id="send">Send</button>
+</form>
+<script>
+const log = document.getElementById('log');
+const modelSel = document.getElementById('model');
+const statusEl = document.getElementById('status');
+let history = [];
+
+async function loadModels() {
+  try {
+    const r = await fetch('/v1/models');
+    const j = await r.json();
+    modelSel.innerHTML = '';
+    for (const m of j.data) {
+      const o = document.createElement('option');
+      o.value = o.textContent = m.id;
+      modelSel.appendChild(o);
+    }
+    statusEl.textContent = j.data.length + ' model(s)';
+  } catch (e) { statusEl.textContent = 'server unreachable'; }
+}
+
+function add(role, text) {
+  const d = document.createElement('div');
+  d.className = 'msg ' + role;
+  d.textContent = text;
+  log.appendChild(d);
+  log.scrollTop = log.scrollHeight;
+  return d;
+}
+
+async function send(text) {
+  history.push({role: 'user', content: text});
+  add('user', text);
+  const out = add('assistant', '');
+  const t0 = performance.now();
+  document.getElementById('send').disabled = true;
+  try {
+    const r = await fetch('/v1/chat/completions', {
+      method: 'POST', headers: {'Content-Type': 'application/json'},
+      body: JSON.stringify({model: modelSel.value, messages: history,
+                            stream: true})});
+    if (!r.ok) { out.textContent = 'error: ' + await r.text(); return; }
+    const reader = r.body.getReader();
+    const dec = new TextDecoder();
+    let buf = '', content = '', usage = null;
+    for (;;) {
+      const {done, value} = await reader.read();
+      if (done) break;
+      buf += dec.decode(value, {stream: true});
+      let i;
+      while ((i = buf.indexOf('\\n\\n')) >= 0) {
+        const line = buf.slice(0, i).trim(); buf = buf.slice(i + 2);
+        if (!line.startsWith('data: ')) continue;
+        const payload = line.slice(6);
+        if (payload === '[DONE]') continue;
+        const obj = JSON.parse(payload);
+        if (obj.usage) usage = obj.usage;
+        const delta = obj.choices && obj.choices[0] && obj.choices[0].delta;
+        if (delta && delta.content) {
+          content += delta.content;
+          out.textContent = content;
+          log.scrollTop = log.scrollHeight;
+        }
+      }
+    }
+    history.push({role: 'assistant', content});
+    const dt = ((performance.now() - t0) / 1000).toFixed(1);
+    const meta = document.createElement('div');
+    meta.className = 'meta';
+    meta.textContent = dt + 's' + (usage ?
+      ' · ' + usage.completion_tokens + ' tokens' : '');
+    log.appendChild(meta);
+  } finally {
+    document.getElementById('send').disabled = false;
+  }
+}
+
+document.getElementById('f').addEventListener('submit', e => {
+  e.preventDefault();
+  const t = document.getElementById('inp').value.trim();
+  if (!t) return;
+  document.getElementById('inp').value = '';
+  send(t);
+});
+document.getElementById('inp').addEventListener('keydown', e => {
+  if (e.key === 'Enter' && !e.shiftKey) {
+    e.preventDefault();
+    document.getElementById('f').requestSubmit();
+  }
+});
+document.getElementById('clear').addEventListener('click', () => {
+  history = []; log.innerHTML = '';
+});
+loadModels();
+</script>
+</body>
+</html>
+"""

@@ -1,0 +1,64 @@
+"""CLI one-shot inference subcommands (reference core/cli/tts.go +
+transcript.go): real backend subprocesses, real files."""
+import json
+import os
+import wave
+
+import pytest
+
+from localai_tpu.cli import main
+
+
+@pytest.fixture(scope="module")
+def whisper_models_dir(tmp_path_factory):
+    """models dir with a tiny whisper checkpoint named default-whisper."""
+    import torch
+    from transformers import WhisperConfig, WhisperForConditionalGeneration
+
+    root = tmp_path_factory.mktemp("cli-models")
+    d = root / "default-whisper"
+    torch.manual_seed(0)
+    cfg = WhisperConfig(
+        vocab_size=51865, d_model=64, encoder_layers=2, decoder_layers=2,
+        encoder_attention_heads=4, decoder_attention_heads=4,
+        encoder_ffn_dim=128, decoder_ffn_dim=128, num_mel_bins=80,
+        max_source_positions=1500, max_target_positions=64)
+    m = WhisperForConditionalGeneration(cfg)
+    m.generation_config.forced_decoder_ids = None
+    m.generation_config.suppress_tokens = None
+    m.generation_config.begin_suppress_tokens = None
+    m.save_pretrained(str(d), safe_serialization=True)
+    return str(root)
+
+
+def test_cli_version(capsys):
+    assert main(["version"]) == 0
+    assert capsys.readouterr().out.strip()
+
+
+def test_cli_tts_writes_wav(tmp_path, monkeypatch):
+    monkeypatch.setenv("LOCALAI_JAX_PLATFORM", "cpu")
+    out = tmp_path / "speech.wav"
+    rc = main(["tts", "hello from the cli", "--output-file", str(out),
+               "--models-path", str(tmp_path)])
+    assert rc == 0
+    with wave.open(str(out)) as w:
+        assert w.getframerate() == 16000
+        assert w.getnframes() > 1000
+
+
+def test_cli_transcript_formats(tmp_path, monkeypatch, whisper_models_dir,
+                                capsys):
+    monkeypatch.setenv("LOCALAI_JAX_PLATFORM", "cpu")
+    wav = tmp_path / "in.wav"
+    rc = main(["tts", "testing one two three", "--output-file", str(wav),
+               "--models-path", str(tmp_path)])
+    assert rc == 0
+    capsys.readouterr()
+    rc = main(["transcript", str(wav), "--model", "default-whisper",
+               "--models-path", whisper_models_dir,
+               "--output-format", "json"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    payload = json.loads(out[out.index("{"):])
+    assert "text" in payload and "segments" in payload

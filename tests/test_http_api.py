@@ -219,6 +219,31 @@ def test_tts_and_vad_http(stack):
     assert len(segs) == 1 and 0.8 < segs[0]["start"] < 1.3
 
 
+def test_webui_served(stack):
+    """GET / serves the built-in chat UI (reference routes/ui.go role)."""
+    base, _ = stack
+    r = requests.get(base + "/", timeout=30)
+    assert r.status_code == 200
+    assert r.headers["Content-Type"].startswith("text/html")
+    assert "/v1/chat/completions" in r.text
+    assert "/v1/models" in r.text
+
+
+def test_elevenlabs_tts_route(stack):
+    """elevenlabs-shaped /v1/text-to-speech/{voice_id} returns WAV
+    (reference routes/elevenlabs.go)."""
+    import io
+    import wave
+
+    base, _ = stack
+    r = requests.post(base + "/v1/text-to-speech/premade-voice", json={
+        "text": "hello there"}, timeout=120)
+    assert r.status_code == 200, r.text
+    assert r.headers["Content-Type"].startswith("audio/wav")
+    with wave.open(io.BytesIO(r.content)) as w:
+        assert w.getnframes() > 1000
+
+
 def test_stores_http_roundtrip(stack):
     """/stores/* endpoints spawn an implicit store backend on demand."""
     base, _ = stack

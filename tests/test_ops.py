@@ -1,6 +1,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from localai_tpu.ops.norms import rms_norm
 from localai_tpu.ops.rope import RopeConfig, rope_table, apply_rope
@@ -121,8 +122,9 @@ def test_quantize_stacked_per_layer_scales():
                                np.asarray(x @ deq[0]), rtol=1e-4, atol=1e-4)
 
 
-def test_int8_checkpoint_load_and_forward():
-    """dtype=int8 through the REAL loader (quantize_params over the scan
+@pytest.mark.parametrize("qdtype,min_agree", [("int8", 0.8), ("int4", 0.5)])
+def test_quantized_checkpoint_load_and_forward(qdtype, min_agree):
+    """dtype=int8/int4 through the REAL loader (quantize_params over the scan
     layout) must forward without shape errors and stay close to f32."""
     import sys
     sys.path.insert(0, "tests")
@@ -133,14 +135,16 @@ def test_int8_checkpoint_load_and_forward():
     from localai_tpu.engine import load_config, load_params
     from localai_tpu.models.llama import forward_train
 
-    d = tempfile.mkdtemp(prefix="int8ckpt-")
+    d = tempfile.mkdtemp(prefix="qckpt-")
     build_tiny_checkpoint(d)
     cfg32 = load_config(d, dtype="float32")
     p32 = load_params(d, cfg32, dtype="float32")
-    cfg8 = load_config(d, dtype="int8")
-    p8 = load_params(d, cfg8, dtype="int8")
+    cfgq = load_config(d, dtype=qdtype)
+    pq = load_params(d, cfgq, dtype=qdtype)
+    if qdtype == "int4":
+        assert pq["layers"]["wq"]["q"].dtype == jnp.int4
     toks = jnp.arange(10)[None, :] % cfg32.vocab_size
     ref = np.asarray(forward_train(p32, cfg32, toks))
-    out = np.asarray(forward_train(p8, cfg8, toks).astype(jnp.float32))
-    # int8 weights: argmax should survive even if logits wiggle
-    assert (ref.argmax(-1) == out.argmax(-1)).mean() > 0.8
+    out = np.asarray(forward_train(pq, cfgq, toks).astype(jnp.float32))
+    # quantized weights: argmax should mostly survive the rounding
+    assert (ref.argmax(-1) == out.argmax(-1)).mean() > min_agree
