@@ -11,17 +11,79 @@ from localai_tpu.backend import pb
 from localai_tpu.backend.base import BackendServicer
 
 
+class _LatentWrapper:
+    """LatentDiffusion → the DiffusionModel file-output surface."""
+
+    def __init__(self, pipe):
+        self.pipe = pipe
+
+    def generate_image(self, prompt, dst, *, negative_prompt="", width=512,
+                       height=512, steps=20, seed=0):
+        from PIL import Image
+
+        arr = self.pipe.txt2img(prompt, negative_prompt=negative_prompt,
+                                width=width, height=height, steps=steps,
+                                seed=seed)
+        Image.fromarray(arr).save(dst)
+        return dst
+
+    def generate_video(self, prompt, dst, *, num_frames=8, fps=4,
+                       width=128, height=128, steps=8, seed=0):
+        from PIL import Image
+
+        cond, uncond = self.pipe.encode_prompts(prompt)  # once, not per frame
+        frames = []
+        for f in range(num_frames):
+            arr = self.pipe.sample(cond, uncond, width=width, height=height,
+                                   steps=steps, seed=seed + f)
+            frames.append(Image.fromarray(arr))
+        frames[0].save(dst, save_all=True, append_images=frames[1:],
+                       duration=int(1000 / fps), loop=0)
+        return dst
+
+
 class ImageServicer(BackendServicer):
     def __init__(self):
         self.model = None
         self._lock = threading.Lock()
 
     def LoadModel(self, request, context):
+        import os
+
         with self._lock:
             if self.model is None:
-                from localai_tpu.models.diffusion import DiffusionModel
+                model_dir = request.model
+                if request.model_path and not os.path.isdir(model_dir):
+                    model_dir = os.path.join(request.model_path,
+                                             request.model)
+                from localai_tpu.models.latent_diffusion import (
+                    is_diffusers_checkpoint,
+                )
 
-                self.model = DiffusionModel(seed=request.seed or 0)
+                try:
+                    if model_dir and is_diffusers_checkpoint(model_dir):
+                        # real SD-class checkpoint (diffusers layout)
+                        from localai_tpu.models.latent_diffusion import (
+                            LatentDiffusion,
+                        )
+
+                        self.model = _LatentWrapper(LatentDiffusion(
+                            model_dir, dtype=request.dtype or "float32"))
+                    elif model_dir and os.path.isdir(model_dir):
+                        # an explicit checkpoint that is NOT a diffusers
+                        # layout must fail loudly, never silently produce
+                        # random-weights noise
+                        return pb.Result(
+                            success=False,
+                            message=f"{model_dir} is not a diffusers-layout "
+                                    f"checkpoint (no model_index.json)")
+                    else:
+                        from localai_tpu.models.diffusion import DiffusionModel
+
+                        self.model = DiffusionModel(seed=request.seed or 0)
+                except Exception as e:
+                    return pb.Result(success=False,
+                                     message=f"{type(e).__name__}: {e}")
             return pb.Result(success=True, message="ok")
 
     def GenerateImage(self, request, context):
@@ -32,6 +94,7 @@ class ImageServicer(BackendServicer):
         self.model.generate_image(
             request.positive_prompt or "",
             request.dst,
+            negative_prompt=request.negative_prompt or "",
             width=request.width or 256,
             height=request.height or 256,
             steps=request.step or 12,

@@ -261,7 +261,8 @@ class DiffusionModel:
         ids += [0] * (self.cfg.max_text_len - len(ids))
         return jnp.asarray([ids], jnp.int32)
 
-    def generate_image(self, prompt: str, dst: str, *, width: int = 256,
+    def generate_image(self, prompt: str, dst: str, *,
+                       negative_prompt: str = "", width: int = 256,
                        height: int = 256, steps: int = 12, seed: int = 0):
         from PIL import Image
 

@@ -1,0 +1,455 @@
+"""Stable-Diffusion-class latent diffusion in JAX — txt2img from REAL
+checkpoints in the standard diffusers directory layout.
+
+Reference role: the diffusers backend's GenerateImage
+(/root/reference/backend/python/diffusers/backend.py) and the
+stablediffusion-ggml backend (/root/reference/backend/go/
+stablediffusion-ggml/gosd.cpp). TPU-first rebuild: the CLIP text encoder,
+UNet2DCondition (down/mid/up ResNet + cross-attention transformer blocks)
+and VAE decoder are pure JAX functions over a flat {diffusers key: array}
+weight dict loaded straight from `unet/`, `vae/`, `text_encoder/`
+safetensors; the DDIM denoise loop is a lax.scan, so one jitted XLA program
+runs the whole trajectory on the MXU (bf16 matmuls/convs, f32 norms).
+
+Supported layout (SD 1.x/2.x geometry, config-driven so tiny test
+checkpoints load the same way): model_index.json at the root plus
+unet/config.json + unet/diffusion_pytorch_model.safetensors, same for vae/,
+text_encoder/ (+ tokenizer/tokenizer.json).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# ------------------------------------------------------------ weight loading
+
+def _read_safetensors(path: str) -> dict[str, np.ndarray]:
+    from localai_tpu.engine.loader import _SafetensorsFile
+
+    f = _SafetensorsFile(path)
+    try:
+        return {k: np.array(f.get(k)) for k in f.keys()}
+    finally:
+        f.close()
+
+
+def _component_weights(model_dir: str, sub: str) -> dict[str, np.ndarray]:
+    d = os.path.join(model_dir, sub)
+    for name in ("diffusion_pytorch_model.safetensors", "model.safetensors"):
+        p = os.path.join(d, name)
+        if os.path.exists(p):
+            return _read_safetensors(p)
+    raise FileNotFoundError(f"no safetensors for component {sub!r} in {d}")
+
+
+def _component_config(model_dir: str, sub: str) -> dict:
+    with open(os.path.join(model_dir, sub, "config.json")) as fh:
+        return json.load(fh)
+
+
+def is_diffusers_checkpoint(model_dir: str) -> bool:
+    return os.path.exists(os.path.join(model_dir, "model_index.json"))
+
+
+# ------------------------------------------------------------ primitives
+
+def conv2d(x, w, b, stride=1, padding=1):
+    """x NHWC, torch OIHW kernel (transposed to HWIO at load)."""
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride),
+        padding=((padding, padding), (padding, padding)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32)
+    return (out + b).astype(x.dtype)
+
+
+def group_norm(x, gamma, beta, groups, eps=1e-5):
+    """NHWC group norm in f32."""
+    n, h, w, c = x.shape
+    xf = x.astype(jnp.float32).reshape(n, h, w, groups, c // groups)
+    mean = xf.mean(axis=(1, 2, 4), keepdims=True)
+    var = xf.var(axis=(1, 2, 4), keepdims=True)
+    xf = (xf - mean) * jax.lax.rsqrt(var + eps)
+    return (xf.reshape(n, h, w, c) * gamma + beta).astype(x.dtype)
+
+
+def layer_norm(x, gamma, beta, eps=1e-5):
+    xf = x.astype(jnp.float32)
+    mean = xf.mean(-1, keepdims=True)
+    var = xf.var(-1, keepdims=True)
+    return (((xf - mean) * jax.lax.rsqrt(var + eps)) * gamma + beta).astype(
+        x.dtype)
+
+
+def linear(x, w, b=None):
+    """torch [out, in] weight."""
+    y = x @ w.T
+    return y if b is None else y + b
+
+
+def attention(q, k, v, heads: int):
+    """[B, Nq, C] x [B, Nk, C] multi-head attention."""
+    b, nq, c = q.shape
+    nk = k.shape[1]
+    d = c // heads
+    q = q.reshape(b, nq, heads, d).transpose(0, 2, 1, 3)
+    k = k.reshape(b, nk, heads, d).transpose(0, 2, 1, 3)
+    v = v.reshape(b, nk, heads, d).transpose(0, 2, 1, 3)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * (d ** -0.5)
+    p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return o.transpose(0, 2, 1, 3).reshape(b, nq, c)
+
+
+def timestep_embedding(t, dim: int):
+    """diffusers get_timestep_embedding (flip_sin_to_cos=True, shift=0)."""
+    half = dim // 2
+    freqs = jnp.exp(-math.log(10000.0) * jnp.arange(half) / half)
+    ang = t.astype(jnp.float32)[:, None] * freqs[None, :]
+    return jnp.concatenate([jnp.cos(ang), jnp.sin(ang)], axis=-1)
+
+
+# ------------------------------------------------------------ CLIP text
+
+def clip_encode(w: dict, cfg: dict, tokens):
+    """CLIP text encoder → last hidden state [B, S, H] (pre-LN, causal)."""
+    p = "text_model."
+    x = w[p + "embeddings.token_embedding.weight"][tokens]
+    x = x + w[p + "embeddings.position_embedding.weight"][: tokens.shape[1]]
+    heads = cfg["num_attention_heads"]
+    s = tokens.shape[1]
+    causal = jnp.tril(jnp.ones((s, s), bool))
+    for i in range(cfg["num_hidden_layers"]):
+        lp = f"{p}encoder.layers.{i}."
+        h = layer_norm(x, w[lp + "layer_norm1.weight"],
+                       w[lp + "layer_norm1.bias"])
+        q = linear(h, w[lp + "self_attn.q_proj.weight"],
+                   w[lp + "self_attn.q_proj.bias"])
+        k = linear(h, w[lp + "self_attn.k_proj.weight"],
+                   w[lp + "self_attn.k_proj.bias"])
+        v = linear(h, w[lp + "self_attn.v_proj.weight"],
+                   w[lp + "self_attn.v_proj.bias"])
+        b, _, c = q.shape
+        d = c // heads
+        qh = q.reshape(b, s, heads, d).transpose(0, 2, 1, 3)
+        kh = k.reshape(b, s, heads, d).transpose(0, 2, 1, 3)
+        vh = v.reshape(b, s, heads, d).transpose(0, 2, 1, 3)
+        sc = jnp.einsum("bhqd,bhkd->bhqk", qh, kh).astype(jnp.float32)
+        sc = jnp.where(causal, sc * (d ** -0.5), -1e30)
+        pr = jax.nn.softmax(sc, axis=-1).astype(vh.dtype)
+        o = jnp.einsum("bhqk,bhkd->bhqd", pr, vh)
+        o = o.transpose(0, 2, 1, 3).reshape(b, s, c)
+        x = x + linear(o, w[lp + "self_attn.out_proj.weight"],
+                       w[lp + "self_attn.out_proj.bias"])
+        h = layer_norm(x, w[lp + "layer_norm2.weight"],
+                       w[lp + "layer_norm2.bias"])
+        h = linear(h, w[lp + "mlp.fc1.weight"], w[lp + "mlp.fc1.bias"])
+        h = h * jax.nn.sigmoid(1.702 * h)          # quick_gelu
+        x = x + linear(h, w[lp + "mlp.fc2.weight"], w[lp + "mlp.fc2.bias"])
+    return layer_norm(x, w[p + "final_layer_norm.weight"],
+                      w[p + "final_layer_norm.bias"])
+
+
+# ------------------------------------------------------------ UNet blocks
+
+def _resnet(w, pfx, x, temb, groups):
+    h = group_norm(x, w[pfx + "norm1.weight"], w[pfx + "norm1.bias"], groups)
+    h = conv2d(jax.nn.silu(h), w[pfx + "conv1.weight"],
+               w[pfx + "conv1.bias"])
+    if pfx + "time_emb_proj.weight" in w:
+        t = linear(jax.nn.silu(temb), w[pfx + "time_emb_proj.weight"],
+                   w[pfx + "time_emb_proj.bias"])
+        h = h + t[:, None, None, :]
+    h = group_norm(h, w[pfx + "norm2.weight"], w[pfx + "norm2.bias"], groups)
+    h = conv2d(jax.nn.silu(h), w[pfx + "conv2.weight"],
+               w[pfx + "conv2.bias"])
+    if pfx + "conv_shortcut.weight" in w:
+        x = conv2d(x, w[pfx + "conv_shortcut.weight"],
+                   w[pfx + "conv_shortcut.bias"], padding=0)
+    return x + h
+
+
+def _tblock(w, pfx, x, ctx, heads):
+    """BasicTransformerBlock: self-attn, cross-attn, GEGLU ff."""
+    h = layer_norm(x, w[pfx + "norm1.weight"], w[pfx + "norm1.bias"])
+    a = attention(linear(h, w[pfx + "attn1.to_q.weight"]),
+                  linear(h, w[pfx + "attn1.to_k.weight"]),
+                  linear(h, w[pfx + "attn1.to_v.weight"]), heads)
+    x = x + linear(a, w[pfx + "attn1.to_out.0.weight"],
+                   w[pfx + "attn1.to_out.0.bias"])
+    h = layer_norm(x, w[pfx + "norm2.weight"], w[pfx + "norm2.bias"])
+    a = attention(linear(h, w[pfx + "attn2.to_q.weight"]),
+                  linear(ctx, w[pfx + "attn2.to_k.weight"]),
+                  linear(ctx, w[pfx + "attn2.to_v.weight"]), heads)
+    x = x + linear(a, w[pfx + "attn2.to_out.0.weight"],
+                   w[pfx + "attn2.to_out.0.bias"])
+    h = layer_norm(x, w[pfx + "norm3.weight"], w[pfx + "norm3.bias"])
+    h = linear(h, w[pfx + "ff.net.0.proj.weight"],
+               w[pfx + "ff.net.0.proj.bias"])
+    a, gate = jnp.split(h, 2, axis=-1)
+    h = a * jax.nn.gelu(gate)
+    return x + linear(h, w[pfx + "ff.net.2.weight"], w[pfx + "ff.net.2.bias"])
+
+
+def _spatial_transformer(w, pfx, x, ctx, heads, groups, depth=1):
+    """Transformer2DModel over NHWC features (conv proj, SD1 layout)."""
+    n, h_, w_, c = x.shape
+    res = x
+    x = group_norm(x, w[pfx + "norm.weight"], w[pfx + "norm.bias"], groups)
+    if w[pfx + "proj_in.weight"].ndim == 4:
+        x = conv2d(x, w[pfx + "proj_in.weight"], w[pfx + "proj_in.bias"],
+                   padding=0)
+        x = x.reshape(n, h_ * w_, c)
+    else:   # use_linear_projection (SD2)
+        x = x.reshape(n, h_ * w_, c)
+        x = linear(x, w[pfx + "proj_in.weight"], w[pfx + "proj_in.bias"])
+    for d in range(depth):
+        x = _tblock(w, f"{pfx}transformer_blocks.{d}.", x, ctx, heads)
+    if w[pfx + "proj_out.weight"].ndim == 4:
+        x = x.reshape(n, h_, w_, c)
+        x = conv2d(x, w[pfx + "proj_out.weight"], w[pfx + "proj_out.bias"],
+                   padding=0)
+    else:
+        x = linear(x, w[pfx + "proj_out.weight"], w[pfx + "proj_out.bias"])
+        x = x.reshape(n, h_, w_, c)
+    return x + res
+
+
+def unet_apply(w: dict, cfg: dict, latents, t, ctx):
+    """UNet2DCondition forward: latents [B,H,W,4], t [B], ctx [B,S,D]."""
+    groups = cfg.get("norm_num_groups", 32)
+    chans = cfg["block_out_channels"]
+    lpb = cfg.get("layers_per_block", 2)
+    head_dim = cfg.get("attention_head_dim", 8)
+    head_dims = (head_dim if isinstance(head_dim, list)
+                 else [head_dim] * len(chans))
+    down_types = cfg["down_block_types"]
+    up_types = cfg["up_block_types"]
+
+    temb = timestep_embedding(t, chans[0])
+    temb = linear(temb, w["time_embedding.linear_1.weight"],
+                  w["time_embedding.linear_1.bias"])
+    temb = linear(jax.nn.silu(temb), w["time_embedding.linear_2.weight"],
+                  w["time_embedding.linear_2.bias"])
+
+    x = conv2d(latents, w["conv_in.weight"], w["conv_in.bias"])
+    skips = [x]
+    for i, btype in enumerate(down_types):
+        heads = max(1, chans[i] // head_dims[i])
+        for j in range(lpb):
+            x = _resnet(w, f"down_blocks.{i}.resnets.{j}.", x, temb, groups)
+            if "CrossAttn" in btype:
+                x = _spatial_transformer(
+                    w, f"down_blocks.{i}.attentions.{j}.", x, ctx, heads,
+                    groups)
+            skips.append(x)
+        if f"down_blocks.{i}.downsamplers.0.conv.weight" in w:
+            x = conv2d(x, w[f"down_blocks.{i}.downsamplers.0.conv.weight"],
+                       w[f"down_blocks.{i}.downsamplers.0.conv.bias"],
+                       stride=2)
+            skips.append(x)
+
+    heads_mid = max(1, chans[-1] // head_dims[-1])
+    x = _resnet(w, "mid_block.resnets.0.", x, temb, groups)
+    x = _spatial_transformer(w, "mid_block.attentions.0.", x, ctx,
+                             heads_mid, groups)
+    x = _resnet(w, "mid_block.resnets.1.", x, temb, groups)
+
+    for i, btype in enumerate(up_types):
+        ch_i = len(chans) - 1 - i
+        heads = max(1, chans[ch_i] // head_dims[ch_i])
+        for j in range(lpb + 1):
+            x = jnp.concatenate([x, skips.pop()], axis=-1)
+            x = _resnet(w, f"up_blocks.{i}.resnets.{j}.", x, temb, groups)
+            if "CrossAttn" in btype:
+                x = _spatial_transformer(
+                    w, f"up_blocks.{i}.attentions.{j}.", x, ctx, heads,
+                    groups)
+        if f"up_blocks.{i}.upsamplers.0.conv.weight" in w:
+            n, h_, w_, c = x.shape
+            x = jax.image.resize(x, (n, h_ * 2, w_ * 2, c), "nearest")
+            x = conv2d(x, w[f"up_blocks.{i}.upsamplers.0.conv.weight"],
+                       w[f"up_blocks.{i}.upsamplers.0.conv.bias"])
+
+    x = group_norm(x, w["conv_norm_out.weight"], w["conv_norm_out.bias"],
+                   groups)
+    return conv2d(jax.nn.silu(x), w["conv_out.weight"], w["conv_out.bias"])
+
+
+# ------------------------------------------------------------ VAE decoder
+
+def _vae_attn(w, pfx, x, groups):
+    n, h_, w_, c = x.shape
+    res = x
+    x = group_norm(x, w[pfx + "group_norm.weight"],
+                   w[pfx + "group_norm.bias"], groups)
+    x = x.reshape(n, h_ * w_, c)
+    o = attention(linear(x, w[pfx + "to_q.weight"], w[pfx + "to_q.bias"]),
+                  linear(x, w[pfx + "to_k.weight"], w[pfx + "to_k.bias"]),
+                  linear(x, w[pfx + "to_v.weight"], w[pfx + "to_v.bias"]), 1)
+    o = linear(o, w[pfx + "to_out.0.weight"], w[pfx + "to_out.0.bias"])
+    return o.reshape(n, h_, w_, c) + res
+
+
+def vae_decode(w: dict, cfg: dict, latents):
+    """AutoencoderKL decoder: latents [B,h,w,4] → images [B,H,W,3] in [0,1]."""
+    groups = cfg.get("norm_num_groups", 32)
+    scale = cfg.get("scaling_factor", 0.18215)
+    x = latents / scale
+    x = conv2d(x, w["post_quant_conv.weight"], w["post_quant_conv.bias"],
+               padding=0)
+    x = conv2d(x, w["decoder.conv_in.weight"], w["decoder.conv_in.bias"])
+    x = _resnet(w, "decoder.mid_block.resnets.0.", x, None, groups)
+    x = _vae_attn(w, "decoder.mid_block.attentions.0.", x, groups)
+    x = _resnet(w, "decoder.mid_block.resnets.1.", x, None, groups)
+    n_up = len(cfg["block_out_channels"])
+    for i in range(n_up):
+        for j in range(3):
+            x = _resnet(w, f"decoder.up_blocks.{i}.resnets.{j}.", x, None,
+                        groups)
+        if f"decoder.up_blocks.{i}.upsamplers.0.conv.weight" in w:
+            n, h_, w_, c = x.shape
+            x = jax.image.resize(x, (n, h_ * 2, w_ * 2, c), "nearest")
+            x = conv2d(x, w[f"decoder.up_blocks.{i}.upsamplers.0.conv.weight"],
+                       w[f"decoder.up_blocks.{i}.upsamplers.0.conv.bias"])
+    x = group_norm(x, w["decoder.conv_norm_out.weight"],
+                   w["decoder.conv_norm_out.bias"], groups)
+    x = conv2d(jax.nn.silu(x), w["decoder.conv_out.weight"],
+               w["decoder.conv_out.bias"])
+    return jnp.clip(x.astype(jnp.float32) / 2 + 0.5, 0.0, 1.0)
+
+
+# ------------------------------------------------------------ pipeline
+
+@dataclasses.dataclass
+class LatentDiffusion:
+    """txt2img pipeline over a diffusers-layout checkpoint directory."""
+
+    model_dir: str
+    dtype: str = "float32"
+
+    def __post_init__(self):
+        dt = jnp.dtype(self.dtype)
+
+        def to_jax(d):
+            out = {}
+            for k, v in d.items():
+                if v.ndim == 4:           # torch OIHW conv → HWIO
+                    v = v.transpose(2, 3, 1, 0)
+                a = jnp.asarray(v)
+                out[k] = a.astype(dt) if a.dtype in (jnp.float32,
+                                                     jnp.float16,
+                                                     jnp.bfloat16) else a
+            return out
+
+        self.unet_cfg = _component_config(self.model_dir, "unet")
+        self.vae_cfg = _component_config(self.model_dir, "vae")
+        self.text_cfg = _component_config(self.model_dir, "text_encoder")
+        self.unet_w = to_jax(_component_weights(self.model_dir, "unet"))
+        self.vae_w = to_jax(_component_weights(self.model_dir, "vae"))
+        self.text_w = to_jax(_component_weights(self.model_dir,
+                                                "text_encoder"))
+        self.tokenizer = None
+        tok_path = os.path.join(self.model_dir, "tokenizer", "tokenizer.json")
+        if os.path.exists(tok_path):
+            from tokenizers import Tokenizer as HFTok
+
+            self.tokenizer = HFTok.from_file(tok_path)
+
+        # latent downscale = one halving per VAE block transition (8 for SD)
+        self.vae_scale = 2 ** (len(self.vae_cfg["block_out_channels"]) - 1)
+        # scaled-linear (sqrt-space) beta schedule — SD's PNDM/DDIM default
+        n_train = 1000
+        betas = jnp.linspace(0.00085 ** 0.5, 0.012 ** 0.5, n_train) ** 2
+        self.alphas_bar = jnp.cumprod(1.0 - betas)
+        self.n_train = n_train
+        self._sample = jax.jit(
+            partial(self._sample_impl), static_argnames=("steps", "h", "w"))
+
+    def _encode_text(self, prompt: str):
+        s = min(self.text_cfg.get("max_position_embeddings", 77), 77)
+        if self.tokenizer is not None:
+            eos = self.tokenizer.token_to_id("<|endoftext|>")
+            ids = self.tokenizer.encode(prompt).ids
+            if eos is not None:
+                # diffusers pads to 77 with EOS and never truncates it away
+                ids = ids[: s - 1] + [eos]
+                ids = ids + [eos] * (s - len(ids))
+            else:
+                ids = ids[:s] + [0] * max(0, s - len(ids))
+        else:   # stable-hash fallback for tokenizer-less tiny checkpoints
+            import zlib
+
+            v = self.text_cfg["vocab_size"]
+            ids = [zlib.crc32(tk.encode()) % v
+                   for tk in prompt.lower().split()][:s]
+            ids = ids + [0] * (s - len(ids))
+        return jnp.asarray([ids], jnp.int32)
+
+    def _sample_impl(self, cond, uncond, key, *, steps, h, w,
+                     guidance_scale):
+        ctx = jnp.concatenate([uncond, cond], axis=0)
+        lc = self.vae_cfg.get("latent_channels", 4)
+        latents = jax.random.normal(
+            key, (1, h // self.vae_scale, w // self.vae_scale, lc),
+            jnp.float32)
+        ts = jnp.linspace(self.n_train - 1, 0, steps).astype(jnp.int32)
+
+        def body(lat, i):
+            t = ts[i]
+            t_prev = jnp.where(i + 1 < steps, ts[jnp.minimum(i + 1,
+                                                             steps - 1)], -1)
+            lat2 = jnp.concatenate([lat, lat], axis=0).astype(ctx.dtype)
+            eps = unet_apply(self.unet_w, self.unet_cfg, lat2,
+                             jnp.full((2,), t, jnp.int32), ctx)
+            eps = eps.astype(jnp.float32)
+            eps_u, eps_c = eps[:1], eps[1:]
+            e = eps_u + guidance_scale * (eps_c - eps_u)
+            a_t = self.alphas_bar[t]
+            a_prev = jnp.where(t_prev >= 0, self.alphas_bar[t_prev], 1.0)
+            x0 = (lat - jnp.sqrt(1 - a_t) * e) / jnp.sqrt(a_t)
+            lat = jnp.sqrt(a_prev) * x0 + jnp.sqrt(1 - a_prev) * e  # DDIM η=0
+            return lat, None
+
+        latents, _ = jax.lax.scan(body, latents, jnp.arange(steps))
+        return vae_decode(self.vae_w, self.vae_cfg,
+                          latents.astype(ctx.dtype))
+
+    def encode_prompts(self, prompt: str, negative_prompt: str = ""):
+        """(cond, uncond) CLIP embeddings — reusable across frames/seeds."""
+        return (clip_encode(self.text_w, self.text_cfg,
+                            self._encode_text(prompt)),
+                clip_encode(self.text_w, self.text_cfg,
+                            self._encode_text(negative_prompt)))
+
+    def sample(self, cond, uncond, *, width: int, height: int,
+               steps: int = 20, guidance_scale: float = 7.5,
+               seed: int = 0) -> np.ndarray:
+        """Precomputed embeddings → uint8 HWC image."""
+        if (width % self.vae_scale or height % self.vae_scale
+                or width < self.vae_scale or height < self.vae_scale):
+            raise ValueError(
+                f"width/height must be positive multiples of "
+                f"{self.vae_scale} (got {width}x{height})")
+        img = self._sample(cond, uncond, jax.random.PRNGKey(seed),
+                           steps=steps, h=height, w=width,
+                           guidance_scale=guidance_scale)
+        return np.asarray(jax.device_get(
+            jnp.round(img[0] * 255))).astype(np.uint8)
+
+    def txt2img(self, prompt: str, negative_prompt: str = "",
+                width: int = 512, height: int = 512, steps: int = 20,
+                guidance_scale: float = 7.5, seed: int = 0) -> np.ndarray:
+        """→ uint8 HWC image."""
+        cond, uncond = self.encode_prompts(prompt, negative_prompt)
+        return self.sample(cond, uncond, width=width, height=height,
+                           steps=steps, guidance_scale=guidance_scale,
+                           seed=seed)

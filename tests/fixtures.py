@@ -82,3 +82,164 @@ def tiny_checkpoint(tmp_path_factory, **kw) -> str:
         d = tmp_path_factory.mktemp("tinyllama")
         _CACHE[key] = build_tiny_checkpoint(str(d), **kw)
     return _CACHE[key]
+
+
+def _write_safetensors(path: str, tensors: dict):
+    """Minimal safetensors writer (f32 little-endian)."""
+    import numpy as np
+
+    header = {}
+    offset = 0
+    blobs = []
+    for k, v in tensors.items():
+        v = np.ascontiguousarray(v, np.float32)
+        n = v.nbytes
+        header[k] = {"dtype": "F32", "shape": list(v.shape),
+                     "data_offsets": [offset, offset + n]}
+        blobs.append(v.tobytes())
+        offset += n
+    hjson = json.dumps(header).encode()
+    with open(path, "wb") as f:
+        f.write(len(hjson).to_bytes(8, "little"))
+        f.write(hjson)
+        for b in blobs:
+            f.write(b)
+
+
+def build_tiny_sd_checkpoint(dirpath: str) -> str:
+    """Tiny Stable-Diffusion-class checkpoint in the diffusers directory
+    layout (unet/ + vae/ + text_encoder/ safetensors + configs) — the layout
+    localai_tpu.models.latent_diffusion loads. Text encoder is a REAL
+    transformers CLIPTextModel so parity can be checked against torch."""
+    import numpy as np
+    import torch
+    from transformers import CLIPTextConfig, CLIPTextModel
+
+    rng = np.random.default_rng(0)
+
+    def t(*shape, scale=None):
+        scale = scale if scale is not None else (shape[-1] ** -0.5 if
+                                                 len(shape) > 1 else 0.02)
+        return (rng.standard_normal(shape) * scale).astype(np.float32)
+
+    os.makedirs(dirpath, exist_ok=True)
+    with open(os.path.join(dirpath, "model_index.json"), "w") as f:
+        json.dump({"_class_name": "StableDiffusionPipeline"}, f)
+
+    # ---- text encoder: real CLIPTextModel
+    td = os.path.join(dirpath, "text_encoder")
+    tcfg = CLIPTextConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=77)
+    torch.manual_seed(0)
+    CLIPTextModel(tcfg).save_pretrained(td, safe_serialization=True)
+
+    # ---- unet
+    C0, C1, TE, CROSS, G = 32, 64, 64, 64, 8
+    u = {}
+
+    def conv(name, o, i, k=3):
+        u[name + ".weight"] = t(o, i, k, k)
+        u[name + ".bias"] = np.zeros((o,), np.float32)
+
+    def norm(name, c):
+        u[name + ".weight"] = np.ones((c,), np.float32)
+        u[name + ".bias"] = np.zeros((c,), np.float32)
+
+    def lin(name, o, i, bias=True):
+        u[name + ".weight"] = t(o, i)
+        if bias:
+            u[name + ".bias"] = np.zeros((o,), np.float32)
+
+    def resnet(p, cin, cout, temb=True):
+        norm(p + "norm1", cin)
+        conv(p + "conv1", cout, cin)
+        if temb:
+            lin(p + "time_emb_proj", cout, TE)
+        norm(p + "norm2", cout)
+        conv(p + "conv2", cout, cout)
+        if cin != cout:
+            conv(p + "conv_shortcut", cout, cin, k=1)
+
+    def xattn(p, c, heads_dim=8):
+        norm(p + "norm", c)
+        conv(p + "proj_in", c, c, k=1)
+        b = p + "transformer_blocks.0."
+        norm(b + "norm1", c)
+        lin(b + "attn1.to_q", c, c, bias=False)
+        lin(b + "attn1.to_k", c, c, bias=False)
+        lin(b + "attn1.to_v", c, c, bias=False)
+        lin(b + "attn1.to_out.0", c, c)
+        norm(b + "norm2", c)
+        lin(b + "attn2.to_q", c, c, bias=False)
+        lin(b + "attn2.to_k", c, CROSS, bias=False)
+        lin(b + "attn2.to_v", c, CROSS, bias=False)
+        lin(b + "attn2.to_out.0", c, c)
+        norm(b + "norm3", c)
+        lin(b + "ff.net.0.proj", 8 * c, c)
+        lin(b + "ff.net.2", c, 4 * c)
+        conv(p + "proj_out", c, c, k=1)
+
+    conv("conv_in", C0, 4)
+    lin("time_embedding.linear_1", TE, C0)
+    lin("time_embedding.linear_2", TE, TE)
+    # down 0: CrossAttn; down 1: plain with channel change + no downsampler
+    resnet("down_blocks.0.resnets.0.", C0, C0)
+    xattn("down_blocks.0.attentions.0.", C0)
+    conv("down_blocks.0.downsamplers.0.conv", C0, C0)
+    resnet("down_blocks.1.resnets.0.", C0, C1)
+    resnet("mid_block.resnets.0.", C1, C1)
+    xattn("mid_block.attentions.0.", C1)
+    resnet("mid_block.resnets.1.", C1, C1)
+    # up 0 (plain, mirrors down 1): skips C1, C0 ; up 1 (crossattn)
+    resnet("up_blocks.0.resnets.0.", C1 + C1, C1)
+    resnet("up_blocks.0.resnets.1.", C1 + C0, C1)
+    conv("up_blocks.0.upsamplers.0.conv", C1, C1)
+    resnet("up_blocks.1.resnets.0.", C1 + C0, C0)
+    xattn("up_blocks.1.attentions.0.", C0)
+    resnet("up_blocks.1.resnets.1.", C0 + C0, C0)
+    xattn("up_blocks.1.attentions.1.", C0)
+    norm("conv_norm_out", C0)
+    conv("conv_out", 4, C0)
+
+    ud = os.path.join(dirpath, "unet")
+    os.makedirs(ud, exist_ok=True)
+    _write_safetensors(os.path.join(ud, "diffusion_pytorch_model.safetensors"), u)
+    with open(os.path.join(ud, "config.json"), "w") as f:
+        json.dump({
+            "block_out_channels": [C0, C1], "layers_per_block": 1,
+            "attention_head_dim": 8, "cross_attention_dim": CROSS,
+            "norm_num_groups": G, "in_channels": 4, "out_channels": 4,
+            "down_block_types": ["CrossAttnDownBlock2D", "DownBlock2D"],
+            "up_block_types": ["UpBlock2D", "CrossAttnUpBlock2D"],
+        }, f)
+
+    # ---- vae decoder
+    u = {}
+    V0, V1 = 32, 64
+    conv("post_quant_conv", 4, 4, k=1)
+    conv("decoder.conv_in", V1, 4)
+    resnet("decoder.mid_block.resnets.0.", V1, V1, temb=False)
+    norm("decoder.mid_block.attentions.0.group_norm", V1)
+    lin("decoder.mid_block.attentions.0.to_q", V1, V1)
+    lin("decoder.mid_block.attentions.0.to_k", V1, V1)
+    lin("decoder.mid_block.attentions.0.to_v", V1, V1)
+    lin("decoder.mid_block.attentions.0.to_out.0", V1, V1)
+    resnet("decoder.mid_block.resnets.1.", V1, V1, temb=False)
+    for j in range(3):
+        resnet(f"decoder.up_blocks.0.resnets.{j}.", V1, V1, temb=False)
+    conv("decoder.up_blocks.0.upsamplers.0.conv", V1, V1)
+    resnet("decoder.up_blocks.1.resnets.0.", V1, V0, temb=False)
+    for j in (1, 2):
+        resnet(f"decoder.up_blocks.1.resnets.{j}.", V0, V0, temb=False)
+    norm("decoder.conv_norm_out", V0)
+    conv("decoder.conv_out", 3, V0)
+
+    vd = os.path.join(dirpath, "vae")
+    os.makedirs(vd, exist_ok=True)
+    _write_safetensors(os.path.join(vd, "diffusion_pytorch_model.safetensors"), u)
+    with open(os.path.join(vd, "config.json"), "w") as f:
+        json.dump({"block_out_channels": [V0, V1], "latent_channels": 4,
+                   "norm_num_groups": G, "scaling_factor": 0.18215}, f)
+    return dirpath
