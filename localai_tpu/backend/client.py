@@ -114,6 +114,10 @@ class BackendClient:
         return self._calls["GenerateVideo"](pb.GenerateVideoRequest(**kw),
                                             timeout=timeout)
 
+    def detect(self, src: str, timeout: float = 600.0) -> "pb.DetectResponse":
+        return self._calls["Detect"](pb.DetectOptions(src=src),
+                                     timeout=timeout)
+
     def stores_set(self, keys, values, timeout: float = 60.0) -> "pb.Result":
         return self._calls["StoresSet"](pb.StoresSetOptions(
             keys=[pb.StoresKey(floats=k) for k in keys],

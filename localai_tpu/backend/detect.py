@@ -1,0 +1,71 @@
+"""Object-detection backend servicer — the rfdetr backend role.
+
+Reference: /root/reference/backend/python/rfdetr/backend.py — LoadModel pulls
+an RF-DETR model, Detect(src) returns boxes + confidence + class_name. Here
+the model is the JAX DETR family (models/detr.py) loading HF
+DetrForObjectDetection checkpoints.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+import grpc
+
+from localai_tpu.backend import pb
+from localai_tpu.backend.base import BackendServicer
+
+
+class DetectServicer(BackendServicer):
+    def __init__(self):
+        self.detector = None
+        self.model_name = ""
+        self._state = pb.StatusResponse.UNINITIALIZED
+        self._lock = threading.Lock()
+
+    def LoadModel(self, request, context):
+        with self._lock:
+            if self.detector is not None:
+                return pb.Result(success=True, message="already loaded")
+            self._state = pb.StatusResponse.BUSY
+            try:
+                from localai_tpu.models.detr import (
+                    Detector, load_detr_config, load_detr_params,
+                )
+
+                model_dir = request.model
+                if request.model_path and not os.path.isdir(model_dir):
+                    model_dir = os.path.join(request.model_path, request.model)
+                if not os.path.isdir(model_dir):
+                    raise FileNotFoundError(
+                        f"model directory not found: {model_dir}")
+                cfg = load_detr_config(model_dir)
+                params = load_detr_params(model_dir, cfg)
+                self.detector = Detector(cfg, params)
+                self.model_name = request.model
+                self._state = pb.StatusResponse.READY
+                return pb.Result(success=True, message="ok")
+            except Exception as e:
+                self._state = pb.StatusResponse.ERROR
+                return pb.Result(success=False,
+                                 message=f"{type(e).__name__}: {e}")
+
+    def Detect(self, request, context):
+        if self.detector is None:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                          "no model loaded (call LoadModel first)")
+        if not request.src or not os.path.isfile(request.src):
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          f"src is not a readable file: {request.src!r}")
+        try:
+            dets = self.detector.detect(request.src)
+        except Exception as e:
+            context.abort(grpc.StatusCode.INTERNAL,
+                          f"{type(e).__name__}: {e}")
+        return pb.DetectResponse(detections=[
+            pb.Detection(x=d.x, y=d.y, width=d.width, height=d.height,
+                         confidence=d.confidence, class_name=d.class_name)
+            for d in dets])
+
+    def Status(self, request, context):
+        return pb.StatusResponse(state=self._state)

@@ -40,7 +40,7 @@ class LLMServicer(BackendServicer):
 
     def LoadModel(self, request, context):
         with self._load_lock:
-            if self.engine is not None:
+            if self.engine is not None or self.embedder is not None:
                 return pb.Result(success=True, message="already loaded")
             self._state = pb.StatusResponse.BUSY
             try:
@@ -67,6 +67,15 @@ class LLMServicer(BackendServicer):
             model_dir = os.path.join(request.model_path, request.model)
         if not os.path.isdir(model_dir):
             raise FileNotFoundError(f"model directory not found: {model_dir}")
+
+        from localai_tpu.models.bert import is_bert_dir
+
+        if is_bert_dir(model_dir):
+            # encoder checkpoint (BertModel/RobertaModel/...): the universal
+            # embeddings role (reference transformers backend,
+            # backend.py:37,323) — no generation engine, Embedding RPC only
+            self._load_bert(request, model_dir)
+            return
 
         cfg = load_config(model_dir, dtype=request.dtype or None)
         devices = jax.devices()
@@ -107,6 +116,14 @@ class LLMServicer(BackendServicer):
             dcfg = load_config(draft_dir, dtype=request.dtype or None)
             draft = (dcfg, load_params(draft_dir, dcfg,
                                        dtype=request.dtype or None))
+        from localai_tpu.ops.kvcache import is_quant_kind
+
+        # one storage kind for both K and V (quantize when either side asks;
+        # the reference allows split k/v types — grpc-server.cpp:236-251)
+        cache_type = ""
+        if (is_quant_kind(request.cache_type_key)
+                or is_quant_kind(request.cache_type_value)):
+            cache_type = "int8"
         self.engine = Engine(cfg, params, tok, EngineConfig(
             max_slots=request.parallel or 4,
             max_context=context_size,
@@ -114,6 +131,7 @@ class LLMServicer(BackendServicer):
             prefill_chunk=chunk,
             mesh=mesh,
             gamma=request.n_draft or 4,
+            cache_type=cache_type,
         ), draft=draft)
         if request.embeddings:
             from localai_tpu.engine.embedder import CrossScorer
@@ -123,6 +141,26 @@ class LLMServicer(BackendServicer):
         self.cfg, self.tok = cfg, tok
         self.model_name = request.model
         self.engine.start()
+
+    def _load_bert(self, request, model_dir: str):
+        """Embedding-only load path for BERT-family encoders: generation RPCs
+        stay FAILED_PRECONDITION (engine is None), Embedding serves."""
+        from localai_tpu.engine.loader import load_tokenizer
+        from localai_tpu.models.bert import (
+            BertEmbedder, load_bert_config, load_bert_params,
+        )
+
+        cfg = load_bert_config(model_dir, dtype=request.dtype or None)
+        params = load_bert_params(model_dir, cfg)
+        buckets = tuple(request.prefill_buckets) or (64, 256, 512)
+        self.embedder = BertEmbedder(cfg, params, buckets=buckets)
+        try:
+            self.tok = load_tokenizer(model_dir)
+        except FileNotFoundError:
+            # tokenizer-less checkpoint still serves the prompt_ids path
+            self.tok = None
+        self.cfg = cfg
+        self.model_name = request.model
 
     # ------------------------------------------------------------ helpers
 

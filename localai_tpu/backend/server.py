@@ -57,6 +57,13 @@ def _make_tts():
     return TTSServicer()
 
 
+@_role("detect")
+def _make_detect():
+    from localai_tpu.backend.detect import DetectServicer
+
+    return DetectServicer()
+
+
 @_role("store")
 def _make_store():
     from localai_tpu.backend.store import StoreServicer

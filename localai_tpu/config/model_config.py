@@ -88,6 +88,8 @@ class ModelConfig:
     grammar: str = ""
     draft_model: str = ""            # speculative decoding draft checkpoint
     n_draft: int = 0                 # draft tokens per step (0 = default 4)
+    cache_type_k: str = ""           # KV cache storage: ""|bf16|int8|q8_0
+    cache_type_v: str = ""           # (reference cache_type_k/v YAML keys)
     pipeline: Pipeline = dataclasses.field(default_factory=Pipeline)
     known_usecases: list[str] = dataclasses.field(default_factory=list)
     # file this config came from (set by the loader)

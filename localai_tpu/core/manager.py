@@ -117,6 +117,8 @@ class ModelManager:
                          or os.path.isabs(m.draft_model)
                          else os.path.join(cfg.models_path, m.draft_model)),
             n_draft=m.n_draft,
+            cache_type_key=m.cache_type_k,
+            cache_type_value=m.cache_type_v,
         )
         if not r.success:
             raise RuntimeError(f"LoadModel({m.name}) failed: {r.message}")

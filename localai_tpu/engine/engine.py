@@ -63,7 +63,14 @@ class EngineConfig:
     prefill_buckets: tuple[int, ...] = (64, 256, 1024)
     prefill_chunk: int = 256      # chunked-prefill window (tokens/engine tick)
     pipeline: bool = True         # keep one decode step in flight
+    decode_block: int = 16        # decode steps fused per device dispatch
+                                  # (amortizes host↔device latency; falls back
+                                  # to single steps around grammar masks,
+                                  # pending admissions, and context limits)
     dtype: str | None = None      # default: model dtype
+    cache_type: str = ""          # ""|bf16 dense; int8|q8_0 quantized KV
+                                  # (reference CacheTypeKey/Value,
+                                  # backend.proto:257-258)
     mesh: Any | None = None       # jax.sharding.Mesh for TP/DP sharding
     shift_keep: int = 4           # context-shift: sink tokens always kept
     replicator: Any | None = None  # multi-host: rank-0 step broadcaster
@@ -155,7 +162,8 @@ class Engine:
         with activate_mesh(self.mesh):
             cos, sin = rope_table(cfg.rope, T)
             self._cos, self._sin = cos, sin
-            self._kc, self._vc = init_kv_cache(cfg, B, T, dtype)
+            self._kc, self._vc = init_kv_cache(cfg, B, T, dtype,
+                                               cache_type=self.ec.cache_type)
             self._sampler = SamplerState.init(B, V)
             self._last_logits = jnp.zeros((B, V), jnp.float32)
             self._lengths = jnp.zeros((B,), jnp.int32)
@@ -333,6 +341,31 @@ class Engine:
         self._decode_nomask_fn = jax.jit(
             partial(_decode, mask_bits=None), donate_argnums=(3, 4, 5, 6, 7))
 
+        def _decode_block(params, cos, sin, kc, vc, sampler, last_logits,
+                          lengths, active, *, steps: int):
+            """`steps` fused sample→decode iterations in ONE device program.
+
+            One dispatch + one result fetch per `steps` tokens: on a remote
+            (tunneled) TPU the per-call host↔device round trip is tens of ms —
+            more than the decode step itself — so fusing the loop is worth
+            ~steps× decode throughput. Grammar masks can't ride here (the PDA
+            must advance per token); the loop falls back to single steps."""
+            def body(carry, _):
+                kc, vc, sampler, last_logits, lengths = carry
+                tokens, logprobs, kc, vc, sampler, last_logits, lengths = (
+                    _decode(params, cos, sin, kc, vc, sampler, last_logits,
+                            lengths, active, None))
+                return (kc, vc, sampler, last_logits, lengths), (tokens,
+                                                                 logprobs)
+            carry = (kc, vc, sampler, last_logits, lengths)
+            carry, (toks, lps) = jax.lax.scan(body, carry, None, length=steps)
+            kc, vc, sampler, last_logits, lengths = carry
+            return toks, lps, kc, vc, sampler, last_logits, lengths
+
+        self._decode_block_fn = jax.jit(
+            _decode_block, donate_argnums=(3, 4, 5, 6, 7),
+            static_argnames=("steps",))
+
     # ------------------------------------------------------ device dispatch
     # Every device call goes through one of these. On a multi-host mesh the
     # rank-0 engine broadcasts (op, args) over the Replicator side channel
@@ -400,6 +433,16 @@ class Engine:
                     *args)
         return tokens, logprobs
 
+    def _dev_decode_block(self, active, steps: int):
+        self._bcast("decode_block", active=active, steps=steps)
+        with activate_mesh(self.mesh):
+            (tokens, logprobs, self._kc, self._vc, self._sampler,
+             self._last_logits, self._lengths) = self._decode_block_fn(
+                self.params, self._cos, self._sin,
+                self._kc, self._vc, self._sampler, self._last_logits,
+                self._lengths, jnp.asarray(active), steps=steps)
+        return tokens, logprobs
+
     def _dev_shift(self, idx):
         self._bcast("shift", idx=idx)
         with activate_mesh(self.mesh):
@@ -452,6 +495,8 @@ class Engine:
                                        kw["idx"], kw["row"], kw["counts_row"])
             elif op == "decode":
                 self._dev_decode(kw["active"], kw["mask"])
+            elif op == "decode_block":
+                self._dev_decode_block(kw["active"], int(kw["steps"]))
             elif op == "shift":
                 self._dev_shift(kw["idx"])
             elif op == "draft_ingest":
@@ -619,17 +664,44 @@ class Engine:
         return np.array([s is not None and s.prefilled for s in self._slots],
                         bool)
 
+    def _block_steps(self) -> int:
+        """How many decode steps the next dispatch may fuse. 1 whenever any
+        per-token host decision is live: grammar masks, pending admissions or
+        chunked prefills (so new requests don't wait a whole block), a slot
+        near its context limit / shift boundary, or a slot that would finish
+        well inside the block (don't burn steps past max_tokens)."""
+        G = self.ec.decode_block
+        if (G <= 1 or self._grammar_slots > 0 or not self.ec.pipeline
+                or self._prefillq or not self._queue.empty()):
+            return 1
+        limit = self.ec.max_context - 2 - self._ctx_reserve
+        for s in self._slots:
+            if s is None or not s.prefilled:
+                continue
+            # 2G margin: with one block pipelined in flight, host-side
+            # `generated` is stale by up to a full block when this guard runs
+            if s.prompt_len + s.generated - s.shifted + 2 * G >= limit:
+                return 1
+            if s.generated + 2 * G > s.req.max_tokens:
+                return 1
+        return G
+
     def _dispatch(self):
-        """Dispatch one decode step for the currently-active slots; returns
-        (tokens_dev, logprobs_dev, [(slot_idx, request_id)]) without waiting
-        for the device — or None if nothing is active."""
+        """Dispatch one decode step — or a fused block of them — for the
+        currently-active slots; returns (tokens_dev, logprobs_dev,
+        [(slot_idx, request_id)]) without waiting for the device — or None if
+        nothing is active. Block results have a leading steps axis."""
         active = self._active_mask()
         if not active.any():
             return None
         entries = [(int(i), self._slots[i].request_id)
                    for i in np.where(active)[0]]
-        tokens, logprobs = self._dev_decode(
-            active, self._mask_host if self._grammar_slots > 0 else None)
+        steps = self._block_steps()
+        if steps > 1:
+            tokens, logprobs = self._dev_decode_block(active, steps)
+        else:
+            tokens, logprobs = self._dev_decode(
+                active, self._mask_host if self._grammar_slots > 0 else None)
         return tokens, logprobs, entries
 
     def _consume(self, pend):
@@ -640,11 +712,15 @@ class Engine:
         tokens = np.asarray(jax.device_get(tokens))
         logprobs = np.asarray(jax.device_get(logprobs))
         now = time.monotonic()
-        for i, rid in entries:
-            slot = self._slots[i]
-            if slot is None or slot.request_id != rid:
-                continue
-            self._emit(i, slot, int(tokens[i]), float(logprobs[i]), now)
+        if tokens.ndim == 1:
+            tokens, logprobs = tokens[None], logprobs[None]
+        for g in range(tokens.shape[0]):
+            for i, rid in entries:
+                slot = self._slots[i]
+                if slot is None or slot.request_id != rid:
+                    continue  # finished earlier in this block (EOS/stop/len)
+                self._emit(i, slot, int(tokens[g, i]), float(logprobs[g, i]),
+                           now)
 
     def _step_spec(self) -> bool:
         """Spec-mode iteration: one batched draft+verify step for all active

@@ -28,6 +28,10 @@ from jax.sharding import PartitionSpec as P
 from localai_tpu.ops.norms import rms_norm
 from localai_tpu.ops.rope import RopeConfig, rope_table, apply_rope
 from localai_tpu.ops.attention import mha_prefill, mha_decode
+from localai_tpu.ops.kvcache import (
+    QuantKV, cache_scatter, dequant, init_quant, is_quant_kind, padded_len,
+    requantize,
+)
 from localai_tpu.ops.quant import qmatmul
 from localai_tpu.parallel.mesh import constrain
 
@@ -162,15 +166,29 @@ def max_model_axis(cfg: LlamaConfig, n_devices: int) -> int:
     return 1
 
 
-def kv_cache_spec():
+def kv_cache_spec(cache_type: str = ""):
     """KV cache [L, B, KVH, T, D]: slots on `data`, kv heads on `model`."""
-    return P(None, "data", "model", None, None)
+    spec = P(None, "data", "model", None, None)
+    if is_quant_kind(cache_type):
+        return QuantKV(q=spec, s=spec)
+    return spec
 
 
-def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int, dtype=None):
+def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int, dtype=None,
+                  cache_type: str = ""):
     """Head-major cache [L, B, KVH, T, D] — trailing (T, D) dims are the
     Mosaic-legal tiling for the Pallas decode kernel, and the decode hot path
-    reads it with zero transposes."""
+    reads it with zero transposes.
+
+    cache_type "int8"/"q8_0" (reference CacheTypeKey/Value,
+    /root/reference/backend/backend.proto:257-258) stores int8 + per-token
+    scales (ops/kvcache.py) at half the HBM; the token axis is then padded to
+    the 128 scale tile (extra rows are never read — lengths mask them).
+    """
+    if is_quant_kind(cache_type):
+        shape = (cfg.num_layers, batch, cfg.num_kv_heads,
+                 padded_len(max_len), cfg.head_dim)
+        return init_quant(shape), init_quant(shape)
     dtype = dtype or cfg.jdtype
     shape = (cfg.num_layers, batch, cfg.num_kv_heads, max_len, cfg.head_dim)
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
@@ -182,6 +200,9 @@ def _cache_write(kc, vc, k, v, rows, positions):
     kvh = kc.shape[1]
     idx = (rows[:, None, None], jnp.arange(kvh)[None, :, None],
            positions[:, None, :])
+    if isinstance(kc, QuantKV):
+        return (cache_scatter(kc, idx, k.transpose(0, 2, 1, 3)),
+                cache_scatter(vc, idx, v.transpose(0, 2, 1, 3)))
     kc = kc.at[idx].set(k.transpose(0, 2, 1, 3))
     vc = vc.at[idx].set(v.transpose(0, 2, 1, 3))
     return kc, vc
@@ -231,7 +252,15 @@ def _seq_ax():
     return "seq" if seq_axis_size(current_mesh()) > 1 else None
 
 
-def _attn_impls(cfg: LlamaConfig | None = None):
+def _decode_dq(q, kc, vc, lengths, sliding_window=None):
+    """XLA decode attention over a (possibly quantized) cache: dequant is
+    fused into the consuming dots by XLA; quantized caches still halve HBM
+    capacity on this path."""
+    return mha_decode(q, dequant(kc), dequant(vc), lengths,
+                      sliding_window=sliding_window)
+
+
+def _attn_impls(cfg: LlamaConfig | None = None, kv_quant: bool = False):
     """Select attention kernels at trace time: Pallas (fused, online-softmax)
     on single-chip TPU; XLA reference under a mesh (GSPMD shards the einsums)
     or on CPU. LOCALAI_FORCE_PALLAS=1 forces Pallas (interpreter on CPU —
@@ -255,7 +284,7 @@ def _attn_impls(cfg: LlamaConfig | None = None):
             return (lambda q, k, v, lengths, sliding_window=None:
                     ring_prefill(q, k, v, lengths, mesh=mesh,
                                  sliding_window=sliding_window),
-                    mha_decode)
+                    _decode_dq)
     use = force or (not block and jax.default_backend() == "tpu"
                     and current_mesh() is None)
     if use and not force:
@@ -266,17 +295,26 @@ def _attn_impls(cfg: LlamaConfig | None = None):
 
         if cfg is not None:
             use = pallas_works(cfg.num_heads, cfg.num_kv_heads, cfg.head_dim,
-                               cfg.sliding_window, cfg.jdtype)
+                               cfg.sliding_window, cfg.jdtype,
+                               kv_quant=kv_quant)
         else:
-            use = pallas_works()
+            use = pallas_works(kv_quant=kv_quant)
     if use:
-        from localai_tpu.ops.pallas import flash_prefill, ragged_decode
+        from localai_tpu.ops.pallas import (
+            flash_prefill, ragged_decode, ragged_decode_q8,
+        )
+
+        def attn_decode(q, kc, vc, lengths, sliding_window=None):
+            if isinstance(kc, QuantKV):
+                return ragged_decode_q8(q, kc.q, kc.s, vc.q, vc.s, lengths,
+                                        sliding_window=sliding_window)
+            return ragged_decode(q, kc, vc, lengths,
+                                 sliding_window=sliding_window)
 
         return (lambda q, k, v, lengths, sliding_window=None:
                 flash_prefill(q, k, v, lengths, sliding_window=sliding_window),
-                lambda q, kc, vc, lengths, sliding_window=None:
-                ragged_decode(q, kc, vc, lengths, sliding_window=sliding_window))
-    return mha_prefill, mha_decode
+                attn_decode)
+    return mha_prefill, _decode_dq
 
 
 def prefill(params, cfg: LlamaConfig, tokens, lengths, cos, sin,
@@ -334,7 +372,7 @@ def decode_step(params, cfg: LlamaConfig, tokens, lengths, cos, sin,
     """
     b = tokens.shape[0]
     T = k_cache.shape[3]
-    _, attn_decode = _attn_impls(cfg)
+    _, attn_decode = _attn_impls(cfg, kv_quant=isinstance(k_cache, QuantKV))
     positions = lengths[:, None]  # [B,1]
     wpos = positions if active is None else jnp.where(
         active[:, None], positions, T - 1)
@@ -422,7 +460,7 @@ def extend(params, cfg: LlamaConfig, tokens, start, cos, sin,
         kc, vc = _cache_write(kc, vc, k, v, rows, positions)
         kr = kc if slot_map is None else kc[rows]
         vr = vc if slot_map is None else vc[rows]
-        attn = mha_extend(q, kr, vr, positions,
+        attn = mha_extend(q, dequant(kr), dequant(vr), positions,
                           sliding_window=cfg.sliding_window)
         x = x + qmatmul(attn.reshape(b, s, -1), lp["wo"])
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
@@ -461,8 +499,11 @@ def cache_shift(cfg: LlamaConfig, k_cache, v_cache, lengths, slot, *,
     c, s = jnp.cos(ang), jnp.sin(ang)
 
     T = k_cache.shape[3]
-    ks = k_cache[:, slot]                        # [L, KVH, T, D]
-    vs = v_cache[:, slot]
+    quant = isinstance(k_cache, QuantKV)
+    # quantized caches shift in f32 and requantize the slot (fresh scales);
+    # only the shifted slot pays the dequant→requant round trip
+    ks = dequant(k_cache[:, slot], jnp.float32) if quant else k_cache[:, slot]
+    vs = dequant(v_cache[:, slot], jnp.float32) if quant else v_cache[:, slot]
     ks_m = jnp.roll(ks, -discard, axis=2)
     vs_m = jnp.roll(vs, -discard, axis=2)
     # R(-d): x1' = x1·cos + x2·sin ; x2' = x2·cos - x1·sin
@@ -472,8 +513,18 @@ def cache_shift(cfg: LlamaConfig, k_cache, v_cache, lengths, slot, *,
     idx = jnp.arange(T)[None, None, :, None]
     length = lengths[slot]
     move = (idx >= keep) & (idx < length - discard)
-    k_cache = k_cache.at[:, slot].set(jnp.where(move, ks_rot, ks))
-    v_cache = v_cache.at[:, slot].set(jnp.where(move, vs_m, vs))
+    k_new = jnp.where(move, ks_rot, ks)
+    v_new = jnp.where(move, vs_m, vs)
+    if quant:
+        kq = requantize(k_cache[:, slot], k_new)
+        vq = requantize(v_cache[:, slot], v_new)
+        k_cache = QuantKV(k_cache.q.at[:, slot].set(kq.q),
+                          k_cache.s.at[:, slot].set(kq.s))
+        v_cache = QuantKV(v_cache.q.at[:, slot].set(vq.q),
+                          v_cache.s.at[:, slot].set(vq.s))
+    else:
+        k_cache = k_cache.at[:, slot].set(k_new)
+        v_cache = v_cache.at[:, slot].set(v_new)
     lengths = lengths.at[slot].add(-discard)
     return k_cache, v_cache, lengths
 

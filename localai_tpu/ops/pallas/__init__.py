@@ -1,6 +1,7 @@
 from localai_tpu.ops.pallas.flash_attention import (  # noqa: F401
     flash_prefill,
     ragged_decode,
+    ragged_decode_q8,
     pallas_available,
     pallas_works,
 )

@@ -258,6 +258,118 @@ def ragged_decode(q, k_cache, v_cache, lengths, sliding_window=None,
     return out.reshape(B, 1, H, D)
 
 
+# ----------------------------------------------------- int8 KV decode
+
+def _decode_q8_kernel(lengths_ref, q_ref, kq_ref, ks_ref, vq_ref, vs_ref,
+                      o_ref, m_ref, l_ref, acc_ref, *,
+                      num_kb: int, t_total: int, scale: float,
+                      sliding_window: int | None):
+    """ragged_decode against an int8 cache: K/V stream from HBM as int8 (half
+    the decode bandwidth — the resource decode is bound by); scales are one
+    aligned [1, 128] row per 128-token block, applied to score columns (K) and
+    to p's columns before the p@v matmul (V) so the matmuls stay dense."""
+    b = pl.program_id(0)
+    kb = pl.program_id(2)
+    length = lengths_ref[b]
+    block_k = 128
+
+    @pl.when(kb == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    start = kb * block_k
+    live = start < length
+    if sliding_window is not None:
+        live &= (start + block_k) > (length - sliding_window)
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * scale            # [G, D]
+        k_blk = kq_ref[0, 0].astype(jnp.float32)               # [BK, D]
+        v_blk = vq_ref[0, 0].astype(jnp.float32)
+        k_s = ks_ref[0, 0, pl.ds(kb, 1), :]                    # [1, BK]
+        v_s = vs_ref[0, 0, pl.ds(kb, 1), :]
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        s = s * k_s                                            # dequant K
+        k_pos = start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mask = k_pos < jnp.minimum(length, t_total)
+        if sliding_window is not None:
+            mask &= k_pos >= length - sliding_window
+        s = jnp.where(mask, s, NEG_INF)
+        m_prev = m_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new[:, :1])
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha[:, :1] + jnp.dot(
+            p * v_s, v_blk, preferred_element_type=jnp.float32)  # dequant V
+        m_ref[...] = m_new
+
+    @pl.when(kb == num_kb - 1)
+    def _finish():
+        out = acc_ref[...] / jnp.maximum(l_ref[...][:, :1], 1e-30)
+        o_ref[0, 0] = out.astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("sliding_window",))
+def ragged_decode_q8(q, k_q, k_s, v_q, v_s, lengths, sliding_window=None):
+    """Decode-step GQA attention over an int8 KV cache (ops/kvcache.py
+    layout). q: [B, 1, H, D]; k_q/v_q: [B, KVH, T, D] int8;
+    k_s/v_s: [B, KVH, T//128, 128] f32 (token t's scale at [t//128, t%128]);
+    lengths: [B]. T must be a multiple of 128. Returns [B, 1, H, D]."""
+    B, _, H, D = q.shape
+    KVH, T = k_q.shape[1], k_q.shape[2]
+    if T % 128:
+        raise ValueError("int8 KV cache length must be a multiple of 128")
+    group = H // KVH
+    num_kb = T // 128
+    scale = D ** -0.5
+    n_tiles = k_s.shape[2]
+
+    qg = q.reshape(B, KVH, group, D)
+
+    def kv_map(b, h, kb, lens):
+        last = jnp.maximum(pl.cdiv(lens[b], 128) - 1, 0)
+        return (b, h, jnp.minimum(kb, last), 0)
+
+    kernel = functools.partial(_decode_q8_kernel, num_kb=num_kb, t_total=T,
+                               scale=scale, sliding_window=sliding_window)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B, KVH, num_kb),
+            in_specs=[
+                pl.BlockSpec((1, 1, group, D),
+                             lambda b, h, kb, lens: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, 128, D), kv_map),
+                # scales ride whole per (slot, head): one small DMA, reused
+                # across every KV block of the row
+                pl.BlockSpec((1, 1, n_tiles, 128),
+                             lambda b, h, kb, lens: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, 128, D), kv_map),
+                pl.BlockSpec((1, 1, n_tiles, 128),
+                             lambda b, h, kb, lens: (b, h, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, group, D),
+                                   lambda b, h, kb, lens: (b, h, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((group, 128), jnp.float32),   # m (lane-replicated)
+                pltpu.VMEM((group, 128), jnp.float32),   # l
+                pltpu.VMEM((group, D), jnp.float32),     # acc
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct(qg.shape, q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(lengths.astype(jnp.int32), qg, k_q, k_s.astype(jnp.float32),
+      v_q, v_s.astype(jnp.float32))
+    return out.reshape(B, 1, H, D)
+
+
 # --------------------------------------------------------------- probe
 
 _PROBE_CACHE: dict[tuple, bool] = {}
@@ -265,7 +377,7 @@ _PROBE_CACHE: dict[tuple, bool] = {}
 
 def pallas_works(num_heads: int = 4, num_kv_heads: int = 2,
                  head_dim: int = 128, sliding_window: int | None = None,
-                 dtype=jnp.bfloat16) -> bool:
+                 dtype=jnp.bfloat16, kv_quant: bool = False) -> bool:
     """Compile-probe the kernels once per (shape, dtype) on this backend.
 
     Round-3 failure mode: the kernels lowered fine in interpreter mode but
@@ -275,7 +387,7 @@ def pallas_works(num_heads: int = 4, num_kv_heads: int = 2,
     letting the attention selector fall back to the XLA path instead of dying.
     """
     key = (num_heads, num_kv_heads, head_dim, sliding_window,
-           jnp.dtype(dtype).name)
+           jnp.dtype(dtype).name, kv_quant)
     if key in _PROBE_CACHE:
         return _PROBE_CACHE[key]
     if jax.default_backend() != "tpu":
@@ -289,9 +401,15 @@ def pallas_works(num_heads: int = 4, num_kv_heads: int = 2,
         flash_prefill(q, kv, kv, lengths,
                       sliding_window=sliding_window).block_until_ready()
         qd = jnp.zeros((B, 1, num_heads, head_dim), dtype)
-        cache = jnp.zeros((B, num_kv_heads, T, head_dim), dtype)
-        ragged_decode(qd, cache, cache, lengths,
-                      sliding_window=sliding_window).block_until_ready()
+        if kv_quant:
+            cq = jnp.zeros((B, num_kv_heads, T, head_dim), jnp.int8)
+            cs = jnp.zeros((B, num_kv_heads, T // 128, 128), jnp.float32)
+            ragged_decode_q8(qd, cq, cs, cq, cs, lengths,
+                             sliding_window=sliding_window).block_until_ready()
+        else:
+            cache = jnp.zeros((B, num_kv_heads, T, head_dim), dtype)
+            ragged_decode(qd, cache, cache, lengths,
+                          sliding_window=sliding_window).block_until_ready()
         ok = True
     except Exception as e:      # pragma: no cover - TPU-only branch
         import logging

@@ -68,6 +68,7 @@ class API:
         r.add_post("/embeddings", self._embeddings)
         r.add_post("/v1/rerank", self._rerank)
         r.add_post("/rerank", self._rerank)
+        r.add_post("/v1/detection", self._detection)
         r.add_post("/v1/tokenize", self._tokenize)
         r.add_post("/tokenize", self._tokenize)
         r.add_get("/v1/realtime", self._realtime)
@@ -454,6 +455,50 @@ class API:
             })
         finally:
             handle.mark_idle()
+
+    async def _detection(self, request):
+        """POST /v1/detection {model, image: base64|data-URI|file path} →
+        {detections: [{x, y, width, height, confidence, class_name}]}
+        (reference endpoints/localai/detection.go + schema.DetectionRequest)."""
+        import base64
+        import os
+        import tempfile
+
+        body = await request.json()
+        cfg = self._resolve(body)
+        image = body.get("image", "")
+        if not image:
+            raise web.HTTPBadRequest(text="image required")
+        tmp = None
+        if os.path.isfile(image):
+            src = image
+        else:
+            if image.startswith("data:"):
+                image = image.split(",", 1)[-1]
+            try:
+                blob = base64.b64decode(image, validate=True)
+            except Exception:
+                raise web.HTTPBadRequest(
+                    text="image must be a file path, base64, or data URI")
+            tmp = tempfile.NamedTemporaryFile(suffix=".img", delete=False)
+            tmp.write(blob)
+            tmp.close()
+            src = tmp.name
+        try:
+            handle = await self._handle(cfg)
+            handle.mark_busy()
+            try:
+                r = await asyncio.to_thread(
+                    lambda: handle.client.detect(src=src))
+                return web.json_response({"detections": [{
+                    "x": d.x, "y": d.y, "width": d.width, "height": d.height,
+                    "confidence": d.confidence, "class_name": d.class_name,
+                } for d in r.detections]})
+            finally:
+                handle.mark_idle()
+        finally:
+            if tmp is not None:
+                os.unlink(tmp.name)
 
     async def _tokenize(self, request):
         body = await request.json()

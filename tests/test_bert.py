@@ -1,0 +1,122 @@
+"""BERT encoder (universal embeddings role) vs HF torch parity on a
+locally-built tiny random checkpoint."""
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def bert_ckpt(tmp_path_factory):
+    import torch
+    from transformers import BertConfig, BertModel
+
+    d = str(tmp_path_factory.mktemp("bert"))
+    torch.manual_seed(0)
+    cfg = BertConfig(
+        vocab_size=200, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=128,
+        max_position_embeddings=64, type_vocab_size=2,
+    )
+    m = BertModel(cfg)
+    m.eval()
+    m.save_pretrained(d, safe_serialization=True)
+    return d
+
+
+def test_config_and_params_load(bert_ckpt):
+    from localai_tpu.models.bert import load_bert_config, load_bert_params
+
+    cfg = load_bert_config(bert_ckpt)
+    assert cfg.hidden_size == 64 and cfg.num_layers == 2
+    params = load_bert_params(bert_ckpt, cfg)
+    assert params["layers"]["wqkv"].shape == (2, 64, 192)
+    assert params["word_emb"].shape == (200, 64)
+
+
+def test_hidden_states_match_hf(bert_ckpt):
+    import torch
+    from transformers import BertModel
+
+    import jax.numpy as jnp
+    from localai_tpu.models.bert import (
+        bert_encode, load_bert_config, load_bert_params,
+    )
+
+    cfg = load_bert_config(bert_ckpt)
+    params = load_bert_params(bert_ckpt, cfg)
+    ids = np.array([[1, 5, 9, 13, 0, 0], [2, 6, 10, 0, 0, 0]], np.int64)
+    lengths = np.array([4, 3], np.int32)
+    mask = (np.arange(6)[None, :] < lengths[:, None]).astype(np.int64)
+
+    ours = np.asarray(bert_encode(params, cfg, jnp.asarray(ids, jnp.int32),
+                                  jnp.asarray(lengths)))
+    m = BertModel.from_pretrained(bert_ckpt)
+    m.eval()
+    with torch.no_grad():
+        ref = m(input_ids=torch.tensor(ids),
+                attention_mask=torch.tensor(mask)).last_hidden_state.numpy()
+    for b in range(2):
+        n = lengths[b]
+        np.testing.assert_allclose(ours[b, :n], ref[b, :n],
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_pooled_matches_hf_mean_pooling(bert_ckpt):
+    import torch
+    from transformers import BertModel
+
+    import jax.numpy as jnp
+    from localai_tpu.models.bert import (
+        bert_pooled, load_bert_config, load_bert_params,
+    )
+
+    cfg = load_bert_config(bert_ckpt)
+    params = load_bert_params(bert_ckpt, cfg)
+    ids = np.array([[3, 7, 11, 15, 19, 0]], np.int64)
+    lengths = np.array([5], np.int32)
+    mask = (np.arange(6)[None, :] < lengths[:, None]).astype(np.int64)
+
+    ours = np.asarray(bert_pooled(params, cfg, jnp.asarray(ids, jnp.int32),
+                                  jnp.asarray(lengths)))
+    m = BertModel.from_pretrained(bert_ckpt)
+    m.eval()
+    with torch.no_grad():
+        h = m(input_ids=torch.tensor(ids),
+              attention_mask=torch.tensor(mask)).last_hidden_state.numpy()
+    mm = mask[..., None].astype(np.float32)
+    ref = (h * mm).sum(1) / mm.sum(1)
+    ref = ref / np.linalg.norm(ref, axis=-1, keepdims=True)
+    np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_bert_embedder_buckets(bert_ckpt):
+    from localai_tpu.models.bert import (
+        BertEmbedder, load_bert_config, load_bert_params,
+    )
+
+    cfg = load_bert_config(bert_ckpt)
+    params = load_bert_params(bert_ckpt, cfg)
+    emb = BertEmbedder(cfg, params, buckets=(8, 16))
+    vecs = emb.embed([[1, 2, 3], [4, 5], [6, 7, 8, 9, 10, 11, 12, 13, 14]])
+    assert vecs.shape == (3, 64)
+    np.testing.assert_allclose(np.linalg.norm(vecs, axis=-1), 1.0, rtol=1e-4)
+    with pytest.raises(ValueError):
+        emb.embed([list(range(1, 20))])
+
+
+def test_servicer_embedding_only_load(bert_ckpt):
+    """LoadModel on a BERT dir serves Embedding and rejects Predict."""
+    from localai_tpu.backend.llm import LLMServicer
+    from localai_tpu.backend import pb
+
+    s = LLMServicer()
+    r = s.LoadModel(pb.ModelOptions(model=bert_ckpt), None)
+    assert r.success, r.message
+    assert s.engine is None and s.embedder is not None
+    res = s.Embedding(pb.PredictOptions(
+        prompt_ids=[1, 2, 3]), _AbortContext())
+    assert len(res.embeddings) == 64
+
+
+class _AbortContext:
+    def abort(self, code, details):
+        raise AssertionError(f"aborted: {code} {details}")

@@ -82,3 +82,55 @@ def test_model_decode_step_compiles_on_tpu():
     dlogits, _, _ = decode_step(params, cfg, step_tokens, step_lengths,
                                 cos, sin, kc, vc)
     assert np.isfinite(np.asarray(dlogits[0])).all()
+
+
+@pytest.mark.parametrize("H,KVH,D", [(8, 4, 64), (32, 8, 128)])
+def test_ragged_decode_q8_lowers_and_matches(H, KVH, D):
+    from localai_tpu.ops.attention import mha_decode
+    from localai_tpu.ops.kvcache import QuantKV, dequant, quantize_tokens
+    from localai_tpu.ops.pallas import ragged_decode_q8
+
+    B, T = 4, 1024
+    q = _bf16(6, (B, 1, H, D))
+    kd = jax.random.normal(jax.random.PRNGKey(7), (B, KVH, T, D))
+    vd = jax.random.normal(jax.random.PRNGKey(8), (B, KVH, T, D))
+    kq, ks = quantize_tokens(kd)
+    vq, vs = quantize_tokens(vd)
+    kc = QuantKV(kq, ks.reshape(B, KVH, T // 128, 128))
+    vc = QuantKV(vq, vs.reshape(B, KVH, T // 128, 128))
+    lengths = jnp.array([1, 100, 777, T], jnp.int32)
+    out = ragged_decode_q8(q, kc.q, kc.s, vc.q, vc.s, lengths)
+    ref = mha_decode(q, dequant(kc), dequant(vc), lengths)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=3e-2, atol=3e-2)
+
+
+def test_decode_block_runs_on_tpu():
+    """The fused multi-step decode program (EngineConfig.decode_block) must
+    compile and run on the chip — it is the serving hot loop."""
+    from localai_tpu.engine import Engine, EngineConfig
+    from localai_tpu.engine.engine import GenRequest, SamplingParams
+    from localai_tpu.models.llama import LlamaConfig, init_params
+
+    cfg = LlamaConfig(vocab_size=256, hidden_size=256, intermediate_size=512,
+                      num_layers=2, num_heads=4, num_kv_heads=2, head_dim=64,
+                      max_position=256)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    eng = Engine(cfg, params, None, EngineConfig(
+        max_slots=2, max_context=128, prefill_buckets=(16,),
+        prefill_chunk=16, decode_block=8))
+    eng.start()
+    try:
+        _, q = eng.submit(GenRequest(
+            prompt_ids=[1, 2, 3], max_tokens=24, ignore_eos=True,
+            params=SamplingParams(temperature=0.0, seed=1)))
+        n = 0
+        while True:
+            o = q.get(timeout=120)
+            n += 1
+            if o.finished:
+                break
+        assert n == 24
+    finally:
+        eng.stop()
