@@ -24,6 +24,10 @@ def _add_run(sub):
     p.add_argument("--watchdog-busy-timeout", default=None)
     p.add_argument("--single-active-backend", action="store_true")
     p.add_argument("--parallel-requests", type=int, default=8)
+    p.add_argument("--backends-path", default=None,
+                   help="installed external backends dir")
+    p.add_argument("--backend-galleries", default=None,
+                   help="comma-separated backend registry index URIs")
     p.add_argument("--galleries", default=None,
                    help="comma-separated gallery index YAMLs (path or URL)")
     p.add_argument("--env-file", default=None,
@@ -165,6 +169,21 @@ def _add_worker(sub):
     return p
 
 
+def _add_explorer(sub):
+    p = sub.add_parser("explorer",
+                       help="federation dashboard + network discovery "
+                            "(reference: core/cli/explorer.go)")
+    p.add_argument("--address", default="127.0.0.1:8509")
+    p.add_argument("--pool-database", default="explorer.json")
+    p.add_argument("--with-sync", action="store_true",
+                   help="poll registered networks in the background")
+    p.add_argument("--only-sync", action="store_true",
+                   help="run the discovery crawler without the dashboard")
+    p.add_argument("--interval", type=float, default=50.0)
+    p.add_argument("--threshold", type=int, default=3)
+    return p
+
+
 def _add_models(sub):
     p = sub.add_parser("models", help="list or install models")
     p.add_argument("action", choices=["list", "install"], nargs="?", default="list")
@@ -172,6 +191,52 @@ def _add_models(sub):
     p.add_argument("--models-path", default="models")
     p.add_argument("--galleries", default=None)
     return p
+
+
+def _add_backends(sub):
+    p = sub.add_parser("backends",
+                       help="list, install, or uninstall serving backends "
+                            "(reference: core/cli backends cmd)")
+    p.add_argument("action", choices=["list", "install", "uninstall"],
+                   nargs="?", default="list")
+    p.add_argument("name", nargs="?", default=None)
+    p.add_argument("--backends-path", default="backends")
+    p.add_argument("--backend-galleries", default=None,
+                   help="comma-separated backend registry index URIs")
+    p.add_argument("--capability", default=None,
+                   help="override detected capability for meta resolution")
+    return p
+
+
+def cli_backends(args) -> int:
+    from localai_tpu.services.backend_gallery import (
+        BackendGallery, delete_backend, install_backend,
+        list_system_backends,
+    )
+
+    if args.action == "list":
+        for b in list_system_backends(args.backends_path):
+            kind = "system" if b.get("system") else "installed"
+            extra = (f" -> {b['meta_backend_for']}"
+                     if b.get("meta_backend_for") else "")
+            print(f"{b['name']}\t{kind}{extra}")
+        return 0
+    if not args.name:
+        print("backend name required", file=sys.stderr)
+        return 2
+    if args.action == "uninstall":
+        delete_backend(args.backends_path, args.name)
+        print(f"uninstalled {args.name}")
+        return 0
+    sources = [s.strip() for s in (args.backend_galleries or "").split(",")
+               if s.strip()]
+    if not sources:
+        print("--backend-galleries required for install", file=sys.stderr)
+        return 2
+    path = install_backend(BackendGallery(sources), args.name,
+                           args.backends_path, capability=args.capability)
+    print(f"installed {args.name} -> {path}")
+    return 0
 
 
 def main(argv=None):
@@ -183,6 +248,8 @@ def main(argv=None):
     _add_run(sub)
     _add_backend(sub)
     _add_models(sub)
+    _add_backends(sub)
+    _add_explorer(sub)
     _add_federated(sub)
     _add_worker(sub)
     _add_tts(sub)
@@ -208,6 +275,12 @@ def main(argv=None):
         from localai_tpu.services.gallery import cli_models
 
         return cli_models(args)
+    if cmd == "backends":
+        return cli_backends(args)
+    if cmd == "explorer":
+        from localai_tpu.explorer import run_explorer
+
+        return run_explorer(args)
     if cmd == "federated":
         from localai_tpu.federation import run_federated
 

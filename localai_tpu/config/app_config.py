@@ -11,7 +11,10 @@ import os
 class AppConfig:
     address: str = "127.0.0.1:8080"
     models_path: str = "models"
-    backends_path: str = ""          # spawn cwd for backend procs ("" = cwd)
+    backends_path: str = ""          # installed external backends dir
+                                     # (also spawn cwd for backend procs)
+    backend_galleries: list[str] = dataclasses.field(default_factory=list)
+                                     # backend registry index URIs
     context_size: int = 0
     parallel_requests: int = 4       # default engine slots per model
     api_keys: list[str] = dataclasses.field(default_factory=list)

@@ -79,11 +79,31 @@ class ModelManager:
         parts = [pkg_root] + [
             p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p]
         env["PYTHONPATH"] = os.pathsep.join(dict.fromkeys(parts))
+        # gallery-installed external backend? its run.sh owns the process
+        # (reference initializers.go:50-99 — external backends launch from
+        # the backends dir); in-tree roles spawn the python module
+        external = None
+        if self.app.backends_path:
+            from localai_tpu.services.backend_gallery import (
+                resolve_backend_dir,
+            )
+
+            external = resolve_backend_dir(self.app.backends_path,
+                                           cfg.backend)
+        if external is not None:
+            argv = ["/bin/sh", os.path.join(external, "run.sh"),
+                    "--addr", f"127.0.0.1:{port}"]
+            cwd = external
+        else:
+            argv = [sys.executable, "-m", "localai_tpu.backend",
+                    "--addr", f"127.0.0.1:{port}", "--backend", cfg.backend]
+            # inherit the parent's cwd: a relative --models-path must resolve
+            # against the launch dir, not the backends dir
+            cwd = None
         proc = subprocess.Popen(
-            [sys.executable, "-m", "localai_tpu.backend",
-             "--addr", f"127.0.0.1:{port}", "--backend", cfg.backend],
+            argv,
             env=env,
-            cwd=self.app.backends_path or None,
+            cwd=cwd,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         )
         # tail child output into our log (reference process.go:140-157)

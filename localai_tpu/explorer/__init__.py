@@ -1,0 +1,308 @@
+"""Explorer: public dashboard of federated serving networks.
+
+Reference: /root/reference/core/explorer/{database.go,discovery.go} + the
+explorer CLI (core/cli/explorer.go) and routes
+(core/http/routes/explorer.go: GET /, POST /network/add, GET /networks).
+
+The reference crawls libp2p networks by token; this build's federation layer
+is HTTP (federation/__init__.py — the libp2p overlay is a documented
+exclusion), so a "network" here is a federated load-balancer endpoint. The
+discovery server polls each network's `/federation/workers` to refresh its
+cluster/worker table and evicts networks after N consecutive failures —
+the same lifecycle as the reference's DiscoveryServer (discovery.go:26-43,
+failedToken)."""
+from __future__ import annotations
+
+import dataclasses
+import fcntl
+import json
+import os
+import threading
+import time
+import urllib.request
+
+
+@dataclasses.dataclass
+class NetworkData:
+    name: str = ""
+    description: str = ""
+    url: str = ""                 # federated LB endpoint
+    clusters: list = dataclasses.field(default_factory=list)
+    failures: int = 0
+
+    def to_dict(self):
+        return dataclasses.asdict(self)
+
+
+class Database:
+    """JSON file database with advisory file locking (database.go role:
+    safe across processes via flock, across threads via a mutex)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._data: dict[str, NetworkData] = {}
+        self._load()
+
+    def _flock(self):
+        lock = open(self.path + ".lock", "w")
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        return lock
+
+    def _load(self):
+        if not os.path.exists(self.path):
+            return
+        with open(self.path) as f:
+            try:
+                raw = json.load(f)
+            except ValueError:
+                raw = {}
+        known = {f.name for f in dataclasses.fields(NetworkData)}
+        self._data = {
+            k: NetworkData(**{kk: vv for kk, vv in v.items() if kk in known})
+            for k, v in raw.items()}
+
+    def _save(self):
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({k: v.to_dict() for k, v in self._data.items()}, f,
+                      indent=1)
+        os.replace(tmp, self.path)
+
+    def get(self, token: str) -> NetworkData | None:
+        lk = self._flock()
+        try:
+            with self._lock:
+                self._load()
+                return self._data.get(token)
+        finally:
+            lk.close()
+
+    def set(self, token: str, nd: NetworkData):
+        lk = self._flock()
+        try:
+            with self._lock:
+                self._load()
+                self._data[token] = nd
+                self._save()
+        finally:
+            lk.close()
+
+    def delete(self, token: str):
+        lk = self._flock()
+        try:
+            with self._lock:
+                self._load()
+                self._data.pop(token, None)
+                self._save()
+        finally:
+            lk.close()
+
+    def token_list(self) -> list[str]:
+        lk = self._flock()
+        try:
+            with self._lock:
+                self._load()
+                return sorted(self._data)
+        finally:
+            lk.close()
+
+    def update(self, token: str, fn):
+        """Atomic read-modify-write: `fn(NetworkData|None) -> NetworkData|None`
+        runs under both locks (None return deletes). get()+set() would drop
+        concurrent writers' updates between the two lock windows."""
+        lk = self._flock()
+        try:
+            with self._lock:
+                self._load()
+                nd = fn(self._data.get(token))
+                if nd is None:
+                    self._data.pop(token, None)
+                else:
+                    self._data[token] = nd
+                self._save()
+        finally:
+            lk.close()
+
+
+class DiscoveryServer:
+    """Keeps the db in sync with live network state (discovery.go:26-43):
+    polls each network's /federation/workers; evicts after `threshold`
+    consecutive failures."""
+
+    def __init__(self, db: Database, interval: float = 50.0,
+                 threshold: int = 3, timeout: float = 5.0):
+        self.db = db
+        self.interval = interval
+        self.threshold = threshold
+        self.timeout = timeout
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def sync_once(self):
+        for token in self.db.token_list():
+            nd = self.db.get(token)
+            if nd is None:
+                continue
+            try:
+                with urllib.request.urlopen(
+                        nd.url.rstrip("/") + "/federation/workers",
+                        timeout=self.timeout) as r:
+                    workers = json.load(r)
+                clusters = [{
+                    "workers": [w.get("url", "") for w in workers],
+                    "type": "federated",
+                    "network_id": token,
+                }]
+
+                def ok(cur, clusters=clusters):
+                    if cur is None:
+                        return None
+                    cur.clusters = clusters
+                    cur.failures = 0
+                    return cur
+
+                self.db.update(token, ok)
+            except Exception:
+                def fail(cur, threshold=self.threshold):
+                    if cur is None:
+                        return None
+                    cur.failures += 1
+                    return None if cur.failures >= threshold else cur
+
+                self.db.update(token, fail)
+
+    def start(self):
+        if self._thread:
+            return
+
+        def loop():
+            while not self._stop.wait(self.interval):
+                self.sync_once()
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+_DASHBOARD = """<!doctype html>
+<html><head><title>LocalAI-TPU Explorer</title><style>
+body{font-family:system-ui;margin:2rem;background:#0b1020;color:#e6e8ef}
+h1{color:#7aa2ff} .net{border:1px solid #2a3350;border-radius:8px;
+padding:1rem;margin:.6rem 0;background:#121a33}
+.small{color:#8b93a7;font-size:.85rem} code{color:#9ece6a}
+input,textarea{width:100%;margin:.2rem 0;background:#1a2342;border:1px solid
+#2a3350;color:#e6e8ef;border-radius:4px;padding:.4rem}
+button{background:#7aa2ff;border:0;border-radius:4px;padding:.5rem 1rem;
+margin-top:.4rem}</style></head>
+<body><h1>Federated networks</h1><div id=nets></div>
+<h2>Register a network</h2>
+<input id=name placeholder=name><input id=url placeholder=http://lb:9090>
+<textarea id=desc placeholder=description></textarea>
+<button onclick="add()">Add</button>
+<script>
+// network fields are untrusted (public POST endpoint): build DOM nodes and
+// assign via textContent only — never innerHTML
+function el(tag,cls,text){const e=document.createElement(tag);
+ if(cls)e.className=cls;if(text!==undefined)e.textContent=text;return e;}
+async function refresh(){
+ const r=await fetch('networks');const nets=await r.json();
+ const box=document.getElementById('nets');box.replaceChildren();
+ for(const n of nets){
+  const d=el('div','net');
+  d.append(el('b','',n.name),' ',el('code','',n.url),
+   document.createElement('br'),el('span','',n.description));
+  const w=(n.clusters||[]).map(c=>c.workers.length+' workers').join(', ');
+  d.append(el('div','small',(w||'no data yet')+' — failures: '+n.failures));
+  box.append(d);
+ }
+}
+async function add(){
+ await fetch('network/add',{method:'POST',headers:{'Content-Type':
+ 'application/json'},body:JSON.stringify({name:name.value,url:url.value,
+ description:desc.value})});refresh();
+}
+refresh();setInterval(refresh,10000);
+</script></body></html>"""
+
+
+def build_explorer_app(db: Database):
+    """aiohttp app with the reference's explorer routes
+    (routes/explorer.go:10-12)."""
+    from aiohttp import web
+
+    async def dashboard(request):
+        return web.Response(text=_DASHBOARD, content_type="text/html")
+
+    async def networks(request):
+        out = []
+        for token in db.token_list():
+            nd = db.get(token)
+            if nd:
+                d = nd.to_dict()
+                d["token"] = token
+                out.append(d)
+        return web.json_response(out)
+
+    async def add_network(request):
+        body = await request.json()
+        url = (body.get("url") or body.get("token") or "").strip()
+        if not url:
+            raise web.HTTPBadRequest(text="url required")
+        token = body.get("token") or url
+        if db.get(token) is not None:
+            raise web.HTTPConflict(text="network already registered")
+        db.set(token, NetworkData(
+            name=body.get("name", ""), url=url,
+            description=body.get("description", "")))
+        return web.json_response({"ok": True, "token": token})
+
+    app = web.Application()
+    app.router.add_get("/", dashboard)
+    app.router.add_get("/networks", networks)
+    app.router.add_post("/network/add", add_network)
+    return app
+
+
+def run_explorer(args) -> int:
+    """CLI `explorer` (reference core/cli/explorer.go)."""
+    import asyncio
+
+    from aiohttp import web
+
+    db = Database(getattr(args, "pool_database", "explorer.json"))
+    ds = None
+    if getattr(args, "with_sync", False) or getattr(args, "only_sync", False):
+        ds = DiscoveryServer(db,
+                             interval=float(getattr(args, "interval", 50.0)),
+                             threshold=int(getattr(args, "threshold", 3)))
+    if getattr(args, "only_sync", False):
+        while True:
+            ds.sync_once()
+            time.sleep(ds.interval)
+    if ds:
+        ds.start()
+    host, _, port = getattr(args, "address", "127.0.0.1:8509").rpartition(":")
+
+    async def serve():
+        runner = web.AppRunner(build_explorer_app(db))
+        await runner.setup()
+        site = web.TCPSite(runner, host or "127.0.0.1", int(port))
+        await site.start()
+        print(f"explorer on {host or '127.0.0.1'}:{port}", flush=True)
+        while True:
+            await asyncio.sleep(3600)
+
+    try:
+        asyncio.run(serve())
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if ds:
+            ds.stop()
+    return 0

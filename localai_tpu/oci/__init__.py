@@ -1,0 +1,272 @@
+"""Minimal OCI distribution client: pull + unpack images and ollama blobs.
+
+Reference: /root/reference/pkg/oci/{image.go,ollama.go,blob.go,tarball.go}
+(go-containerregistry) — backends ship as OCI artifacts
+(`oci://quay.io/...`), models can come from ollama's registry
+(`ollama://gemma:2b`), and `ocifile://` unpacks a local OCI-layout tarball.
+
+This is a dependency-free implementation of the distribution spec's pull
+side: token-auth handshake (WWW-Authenticate Bearer), manifest negotiation
+(OCI index / docker manifest-list → platform manifest → layers), blob fetch
+with sha256 verification, and path-confined tar extraction (symlink/.. tar
+members are rejected — the same traversal class the model gallery guards).
+
+Zero-egress note: this container cannot reach real registries; every code
+path here is exercised by tests against a local in-process registry
+(tests/test_oci.py).
+"""
+from __future__ import annotations
+
+import gzip
+import hashlib
+import io
+import json
+import os
+import tarfile
+import urllib.parse
+import urllib.request
+
+MT_OCI_INDEX = "application/vnd.oci.image.index.v1+json"
+MT_OCI_MANIFEST = "application/vnd.oci.image.manifest.v1+json"
+MT_DOCKER_LIST = "application/vnd.docker.distribution.manifest.list.v2+json"
+MT_DOCKER_MANIFEST = "application/vnd.docker.distribution.manifest.v2+json"
+_ACCEPT = ", ".join((MT_OCI_MANIFEST, MT_OCI_INDEX, MT_DOCKER_MANIFEST,
+                     MT_DOCKER_LIST))
+
+OLLAMA_REGISTRY = "registry.ollama.ai"
+_OLLAMA_MODEL_MT = "application/vnd.ollama.image.model"
+
+
+class OCIError(RuntimeError):
+    pass
+
+
+def parse_ref(ref: str):
+    """'oci://host/repo:tag' → (host, repo, tag). Default tag 'latest';
+    bare repos ('oci://host/name') keep registry semantics."""
+    body = ref.split("://", 1)[1] if "://" in ref else ref
+    host, _, rest = body.partition("/")
+    if not rest:
+        raise OCIError(f"bad OCI reference {ref!r} (no repository)")
+    if "@" in rest:                       # digest pin
+        repo, tag = rest.split("@", 1)
+    elif ":" in rest.rsplit("/", 1)[-1]:
+        repo, tag = rest.rsplit(":", 1)
+    else:
+        repo, tag = rest, "latest"
+    return host, repo, tag
+
+
+def parse_ollama_ref(ref: str):
+    """'ollama://gemma:2b' → (registry.ollama.ai, library/gemma, 2b)."""
+    body = ref.split("://", 1)[1]
+    if ":" in body:
+        repo, tag = body.rsplit(":", 1)
+    else:
+        repo, tag = body, "latest"
+    if "/" not in repo:
+        repo = f"library/{repo}"
+    return OLLAMA_REGISTRY, repo, tag
+
+
+class Registry:
+    """One registry endpoint with lazy bearer-token auth."""
+
+    def __init__(self, host: str, *, insecure: bool | None = None,
+                 timeout: float = 600.0):
+        if insecure is None:
+            # localhost registries (tests, sidecars) default to plain http
+            insecure = host.startswith(("localhost", "127.0.0.1"))
+        self.base = f"{'http' if insecure else 'https'}://{host}"
+        self.timeout = timeout
+        self._token: str | None = None
+
+    def _request(self, url: str, headers: dict) -> "urllib.request.addinfourl":
+        req = urllib.request.Request(url, headers=headers)
+        if self._token:
+            req.add_header("Authorization", f"Bearer {self._token}")
+        try:
+            return urllib.request.urlopen(req, timeout=self.timeout)
+        except urllib.error.HTTPError as e:
+            if e.code == 401 and self._token is None:
+                self._authenticate(e.headers.get("WWW-Authenticate", ""))
+                return self._request(url, headers)
+            raise
+
+    def _authenticate(self, challenge: str):
+        """Bearer realm="...",service="...",scope="..." token dance."""
+        if not challenge.lower().startswith("bearer "):
+            raise OCIError(f"unsupported auth challenge {challenge!r}")
+        fields = dict(
+            kv.split("=", 1) for kv in challenge[7:].split(",") if "=" in kv)
+        fields = {k.strip(): v.strip().strip('"') for k, v in fields.items()}
+        realm = fields.pop("realm", None)
+        if not realm:
+            raise OCIError("auth challenge without realm")
+        q = urllib.parse.urlencode(
+            {k: v for k, v in fields.items() if k in ("service", "scope")})
+        with urllib.request.urlopen(f"{realm}?{q}",
+                                    timeout=self.timeout) as r:
+            tok = json.load(r)
+        self._token = tok.get("token") or tok.get("access_token")
+        if not self._token:
+            raise OCIError("token endpoint returned no token")
+
+    def manifest(self, repo: str, tag: str) -> dict:
+        url = f"{self.base}/v2/{repo}/manifests/{tag}"
+        with self._request(url, {"Accept": _ACCEPT}) as r:
+            m = json.load(r)
+        mt = m.get("mediaType", "")
+        if mt in (MT_OCI_INDEX, MT_DOCKER_LIST) or "manifests" in m:
+            digest = _pick_platform(m["manifests"])
+            with self._request(f"{self.base}/v2/{repo}/manifests/{digest}",
+                               {"Accept": _ACCEPT}) as r:
+                m = json.load(r)
+        return m
+
+    def blob(self, repo: str, digest: str) -> bytes:
+        url = f"{self.base}/v2/{repo}/blobs/{digest}"
+        with self._request(url, {}) as r:
+            data = r.read()
+        algo, _, want = digest.partition(":")
+        got = hashlib.new(algo, data).hexdigest()
+        if got != want:
+            raise OCIError(f"blob digest mismatch: want {want}, got {got}")
+        return data
+
+    def blob_to_file(self, repo: str, digest: str, dest: str,
+                     progress=None) -> str:
+        url = f"{self.base}/v2/{repo}/blobs/{digest}"
+        algo, _, want = digest.partition(":")
+        h = hashlib.new(algo)
+        done = 0
+        with self._request(url, {}) as r, open(dest, "wb") as out:
+            total = int(r.headers.get("Content-Length") or 0)
+            for chunk in iter(lambda: r.read(1 << 20), b""):
+                h.update(chunk)
+                out.write(chunk)
+                done += len(chunk)
+                if progress:
+                    progress(done, total)
+        if h.hexdigest() != want:
+            os.unlink(dest)
+            raise OCIError(f"blob digest mismatch for {digest}")
+        return dest
+
+
+def _pick_platform(manifests: list[dict]) -> str:
+    want_arch = {"x86_64": "amd64", "aarch64": "arm64"}.get(
+        os.uname().machine, os.uname().machine)
+    for m in manifests:
+        plat = m.get("platform") or {}
+        if plat.get("os", "linux") == "linux" and \
+                plat.get("architecture") == want_arch:
+            return m["digest"]
+    return manifests[0]["digest"]
+
+
+def _safe_extract(tf: tarfile.TarFile, dest: str):
+    """Path-confined extraction; strips docker whiteout files."""
+    root = os.path.realpath(dest)
+    for member in tf.getmembers():
+        name = member.name
+        while name.startswith("./"):
+            name = name[2:]
+        name = name.lstrip("/")
+        base = os.path.basename(name)
+        if base.startswith(".wh."):      # overlayfs whiteout: delete target
+            victim = os.path.join(dest, os.path.dirname(name),
+                                  base[len(".wh."):])
+            if os.path.realpath(victim).startswith(root + os.sep):
+                if os.path.isdir(victim):
+                    import shutil
+
+                    shutil.rmtree(victim, ignore_errors=True)
+                elif os.path.exists(victim):
+                    os.unlink(victim)
+            continue
+        target = os.path.realpath(os.path.join(dest, name))
+        if not (target == root or target.startswith(root + os.sep)):
+            raise OCIError(f"tar member escapes destination: {member.name!r}")
+        if member.issym() or member.islnk():
+            link_target = os.path.realpath(
+                os.path.join(dest, os.path.dirname(name), member.linkname))
+            if not link_target.startswith(root + os.sep):
+                raise OCIError(f"tar link escapes destination: {member.name!r}")
+        member.name = name
+        tf.extract(member, dest, filter="data")
+
+
+def _extract_layer(data: bytes, mt: str, dest: str):
+    if "gzip" in mt or data[:2] == b"\x1f\x8b":
+        data = gzip.decompress(data)
+    with tarfile.open(fileobj=io.BytesIO(data)) as tf:
+        _safe_extract(tf, dest)
+
+
+def _extract_layer_file(path: str, dest: str):
+    # 'r:*' sniffs gzip/plain and decompresses as a stream — no in-memory copy
+    with tarfile.open(path, "r:*") as tf:
+        _safe_extract(tf, dest)
+
+
+def pull_image(ref: str, dest: str, *, progress=None,
+               insecure: bool | None = None) -> str:
+    """Pull `oci://host/repo:tag` and unpack all layers into `dest`. Layers
+    stream to a temp file (digest-verified incrementally) so a multi-GB
+    backend image never lives in RAM."""
+    import tempfile
+
+    host, repo, tag = parse_ref(ref)
+    reg = Registry(host, insecure=insecure)
+    manifest = reg.manifest(repo, tag)
+    os.makedirs(dest, exist_ok=True)
+    layers = manifest.get("layers") or []
+    for i, layer in enumerate(layers):
+        tmp = tempfile.NamedTemporaryFile(dir=dest, suffix=".layer",
+                                          delete=False)
+        tmp.close()
+        try:
+            reg.blob_to_file(repo, layer["digest"], tmp.name)
+            _extract_layer_file(tmp.name, dest)
+        finally:
+            if os.path.exists(tmp.name):
+                os.unlink(tmp.name)
+        if progress:
+            progress(i + 1, len(layers))
+    return dest
+
+
+def pull_ollama_model(ref: str, dest_file: str, *, progress=None,
+                      insecure: bool | None = None) -> str:
+    """Pull `ollama://model:tag`'s GGUF model blob to `dest_file`
+    (reference pkg/oci/ollama.go — the model layer is the payload)."""
+    host, repo, tag = parse_ollama_ref(ref)
+    reg = Registry(host, insecure=insecure)
+    manifest = reg.manifest(repo, tag)
+    model = next((l for l in manifest.get("layers", [])
+                  if l.get("mediaType") == _OLLAMA_MODEL_MT), None)
+    if model is None:
+        raise OCIError(f"{ref}: manifest has no model layer")
+    return reg.blob_to_file(repo, model["digest"], dest_file,
+                            progress=progress)
+
+
+def unpack_oci_file(tar_path: str, dest: str) -> str:
+    """`ocifile://` — unpack a local OCI-layout tarball's first manifest's
+    layers into dest (reference pkg/oci/tarball.go)."""
+    os.makedirs(dest, exist_ok=True)
+    with tarfile.open(tar_path) as tf:
+        def read(name):
+            f = tf.extractfile(name)
+            if f is None:
+                raise OCIError(f"{tar_path}: missing {name}")
+            return f.read()
+
+        index = json.loads(read("index.json"))
+        mdig = index["manifests"][0]["digest"].replace(":", "/")
+        manifest = json.loads(read(f"blobs/{mdig}"))
+        for layer in manifest.get("layers", []):
+            data = read("blobs/" + layer["digest"].replace(":", "/"))
+            _extract_layer(data, layer.get("mediaType", ""), dest)
+    return dest

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 import threading
 import time
 
@@ -90,6 +91,13 @@ class API:
         r.add_post("/models/apply", self._models_apply)
         r.add_get("/models/available", self._models_available)
         r.add_get("/models/jobs/{job_id}", self._models_job)
+        # backend gallery (reference routes/localai.go:53-58)
+        r.add_get("/backends", self._backends_list)
+        r.add_get("/backends/available", self._backends_available)
+        r.add_get("/backends/galleries", self._backends_galleries)
+        r.add_post("/backends/apply", self._backends_apply)
+        r.add_post("/backends/delete/{name}", self._backends_delete)
+        r.add_get("/backends/jobs/{job_id}", self._backends_job)
         # WebUI (reference routes/ui.go role) + API-compat route families
         r.add_get("/", self._webui)
         r.add_get("/chat", self._webui)
@@ -97,6 +105,7 @@ class API:
         r.add_post("/v1/text-to-speech/{voice_id}", self._elevenlabs_tts)
         r.add_post("/v1/sound-generation", self._sound_generation)
         self.gallery_service = None  # wired by run_server when galleries set
+        self.backend_gallery_service = None  # ditto (backend registry)
 
     # ------------------------------------------------------------ middleware
 
@@ -818,6 +827,65 @@ class API:
             self.configs.reload()  # new YAML becomes servable immediately
         return web.json_response(st)
 
+    # ------------------------------------------------ backend gallery
+
+    async def _backends_list(self, request):
+        from localai_tpu.services.backend_gallery import list_system_backends
+
+        return web.json_response(await asyncio.to_thread(
+            list_system_backends, self.cfg.backends_path))
+
+    def _require_backend_gallery(self):
+        if self.backend_gallery_service is None:
+            raise web.HTTPBadRequest(
+                text="no backend galleries configured "
+                     "(--backend-galleries / LOCALAI_BACKEND_GALLERIES)")
+        return self.backend_gallery_service
+
+    async def _backends_available(self, request):
+        from localai_tpu.services.backend_gallery import list_system_backends
+
+        svc = self._require_backend_gallery()
+        backends = await asyncio.to_thread(svc.gallery.backends)
+        installed = {b["name"] for b in await asyncio.to_thread(
+            list_system_backends, self.cfg.backends_path)}
+        return web.json_response([{
+            "name": b.name, "description": b.description, "tags": b.tags,
+            "meta": b.is_meta, "installed": b.name in installed,
+        } for b in backends.values()])
+
+    async def _backends_galleries(self, request):
+        svc = self._require_backend_gallery()
+        return web.json_response([{"url": s} for s in svc.gallery.sources])
+
+    async def _backends_apply(self, request):
+        svc = self._require_backend_gallery()
+        body = await request.json()
+        name = body.get("id") or body.get("name") or ""
+        if not name:
+            raise web.HTTPBadRequest(text="backend name required")
+        job = svc.submit(name)
+        return web.json_response({"uuid": job,
+                                  "status": f"/backends/jobs/{job}"})
+
+    async def _backends_delete(self, request):
+        from localai_tpu.services.backend_gallery import delete_backend
+
+        try:
+            await asyncio.to_thread(delete_backend,
+                                    self.cfg.backends_path,
+                                    request.match_info["name"])
+        except KeyError as e:
+            raise web.HTTPNotFound(text=str(e))
+        return web.json_response({"deleted": True})
+
+    async def _backends_job(self, request):
+        svc = self._require_backend_gallery()
+        st = svc.status.get(request.match_info["job_id"])
+        if st is None:
+            raise web.HTTPNotFound()
+        return web.json_response(st)
+
 
 def run_server(args) -> int:
     """CLI `run` entrypoint: assemble config + manager + API and serve
@@ -853,6 +921,27 @@ def run_server(args) -> int:
             app_cfg.models_path)
         svc.start()
         api.gallery_service = svc
+
+    backends_path = getattr(args, "backends_path", None)
+    if backends_path:
+        app_cfg.backends_path = backends_path
+    bgalleries = (getattr(args, "backend_galleries", None)
+                  or os.environ.get("LOCALAI_BACKEND_GALLERIES", ""))
+    if bgalleries:
+        from localai_tpu.services.backend_gallery import (
+            BackendGallery, BackendGalleryService,
+        )
+
+        app_cfg.backend_galleries = [
+            s.strip() for s in bgalleries.split(",") if s.strip()]
+        bsvc = BackendGalleryService(
+            BackendGallery(app_cfg.backend_galleries),
+            app_cfg.backends_path or os.path.join(
+                app_cfg.models_path, "..", "backends"))
+        if not app_cfg.backends_path:
+            app_cfg.backends_path = bsvc.backends_path
+        bsvc.start()
+        api.backend_gallery_service = bsvc
 
     preload = getattr(args, "models", None) or []
     if preload:

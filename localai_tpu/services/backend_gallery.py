@@ -1,0 +1,298 @@
+"""Backend gallery: install/list/delete serving backends, with meta-backends
+resolved by detected hardware capability.
+
+Reference: /root/reference/core/gallery/backends.go:73-439 + the registry
+index format /root/reference/backend/index.yaml — entries carry a
+`capabilities` map (capability key → concrete backend name); installing the
+meta entry picks the concrete backend for the detected system (here
+`tpu-v5e|tpu-v6e|...|cpu`, system/capabilities.py) and records an alias so
+model configs can keep naming the meta backend.
+
+An installed backend is a directory under `backends_path` with a
+`metadata.json` and a `run.sh` (the spawn contract — the ModelManager execs
+`run.sh --addr 127.0.0.1:<port>` for external backends; in-tree roles keep
+spawning `python -m localai_tpu.backend`). Payloads arrive as directories,
+tarballs, or OCI images (`oci://`, via localai_tpu/oci).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import queue
+import shutil
+import tarfile
+import threading
+import uuid
+from typing import Any
+
+import yaml
+
+from localai_tpu.backend.server import ROLES
+from localai_tpu.downloader.uri import download_file, resolve_uri
+from localai_tpu.system.capabilities import detect_capability
+
+METADATA = "metadata.json"
+
+
+@dataclasses.dataclass
+class GalleryBackend:
+    name: str
+    uri: str = ""
+    alias: str = ""
+    description: str = ""
+    mirrors: list[str] = dataclasses.field(default_factory=list)
+    capabilities: dict[str, str] = dataclasses.field(default_factory=dict)
+    license: str = ""
+    tags: list[str] = dataclasses.field(default_factory=list)
+
+    @property
+    def is_meta(self) -> bool:
+        return bool(self.capabilities)
+
+
+class BackendGallery:
+    """Registry index (YAML list) from one or more sources (file/http).
+    The parsed index is cached for `cache_ttl` seconds so a long-running
+    server keeps seeing registry updates without re-fetching per request."""
+
+    def __init__(self, sources: list[str], cache_ttl: float = 60.0):
+        self.sources = sources
+        self.cache_ttl = cache_ttl
+        self._cache: dict[str, GalleryBackend] | None = None
+        self._cached_at = 0.0
+
+    def _fetch(self, src: str) -> list[dict]:
+        import tempfile
+
+        src = resolve_uri(src)
+        if src.startswith(("http://", "https://")):
+            with tempfile.NamedTemporaryFile(suffix=".yaml") as tmp:
+                download_file(src, tmp.name)
+                with open(tmp.name) as f:
+                    return yaml.safe_load(f) or []
+        path = src[len("file://"):] if src.startswith("file://") else src
+        with open(path) as f:
+            return yaml.safe_load(f) or []
+
+    def backends(self) -> dict[str, GalleryBackend]:
+        import time
+
+        if self._cache is None or (time.monotonic() - self._cached_at
+                                   > self.cache_ttl):
+            out: dict[str, GalleryBackend] = {}
+            known = {f.name for f in dataclasses.fields(GalleryBackend)}
+            for src in self.sources:
+                for entry in self._fetch(src):
+                    gb = GalleryBackend(**{k: v for k, v in entry.items()
+                                           if k in known})
+                    out[gb.name] = gb
+            self._cache = out
+            self._cached_at = time.monotonic()
+        return self._cache
+
+    def get(self, name: str) -> GalleryBackend | None:
+        return self.backends().get(name)
+
+
+def resolve_meta(gallery: BackendGallery, gb: GalleryBackend,
+                 capability: str | None = None) -> GalleryBackend:
+    """Meta entry → concrete entry for this system's capability (backends.go
+    FindBestBackendFromMeta). Falls back to the `default` key."""
+    if not gb.is_meta:
+        return gb
+    cap = capability or detect_capability()
+    target = gb.capabilities.get(cap) or gb.capabilities.get("default")
+    if not target:
+        raise KeyError(
+            f"meta backend {gb.name!r} has no candidate for capability "
+            f"{cap!r} (and no default)")
+    concrete = gallery.get(target)
+    if concrete is None:
+        raise KeyError(f"meta backend {gb.name!r} points to unknown "
+                       f"backend {target!r}")
+    return concrete
+
+
+def _write_metadata(path: str, meta: dict):
+    with open(os.path.join(path, METADATA), "w") as f:
+        json.dump(meta, f, indent=1)
+
+
+def install_backend(gallery: BackendGallery, name: str, backends_path: str,
+                    progress=None, capability: str | None = None,
+                    force: bool = False) -> str:
+    """Install `name` (meta or concrete) into backends_path; returns the
+    installed directory. Idempotent unless force."""
+    os.makedirs(backends_path, exist_ok=True)
+    existing = list_system_backends(backends_path)
+    if not force and any(b["name"] == name and not b.get("system")
+                         for b in existing):
+        return os.path.join(backends_path, name)
+    gb = gallery.get(name)
+    if gb is None:
+        raise KeyError(f"backend {name!r} not in galleries")
+    concrete = resolve_meta(gallery, gb, capability)
+
+    dest = os.path.join(backends_path, concrete.name)
+    if os.path.realpath(dest) != os.path.join(
+            os.path.realpath(backends_path), concrete.name):
+        raise ValueError(f"backend name escapes backends path: {name!r}")
+    os.makedirs(dest, exist_ok=True)
+
+    uri = concrete.uri
+    for candidate in [uri] + concrete.mirrors:
+        try:
+            _fetch_payload(candidate, dest, progress)
+            break
+        except Exception:
+            if candidate == (concrete.mirrors or [uri])[-1]:
+                raise
+    meta: dict[str, Any] = {"name": concrete.name, "uri": uri}
+    if concrete.alias:
+        meta["alias"] = concrete.alias
+    _write_metadata(dest, meta)
+
+    if concrete.name != gb.name:
+        # meta alias dir so configs can keep naming the meta backend
+        mdir = os.path.join(backends_path, gb.name)
+        os.makedirs(mdir, exist_ok=True)
+        _write_metadata(mdir, {"name": gb.name,
+                               "meta_backend_for": concrete.name})
+    return dest
+
+
+def _fetch_payload(uri: str, dest: str, progress=None):
+    resolved = resolve_uri(uri)
+    if resolved.startswith("oci://") or resolved.startswith("ocifile://"):
+        download_file(resolved, dest, progress=progress)
+        return
+    path = resolved[len("file://"):] if resolved.startswith("file://") \
+        else resolved
+    if os.path.isdir(path):
+        shutil.copytree(path, dest, dirs_exist_ok=True)
+        return
+    # tarball (local or http)
+    local = path
+    if resolved.startswith(("http://", "https://")):
+        local = os.path.join(dest, ".payload.tar")
+        download_file(resolved, local, progress=progress)
+    with tarfile.open(local) as tf:
+        root = os.path.realpath(dest)
+        for m in tf.getmembers():
+            target = os.path.realpath(os.path.join(dest, m.name))
+            if not (target == root or target.startswith(root + os.sep)):
+                raise ValueError(f"tar member escapes backend dir: {m.name!r}")
+        tf.extractall(dest, filter="data")
+    if local.endswith(".payload.tar"):
+        os.unlink(local)
+
+
+def list_system_backends(backends_path: str) -> list[dict]:
+    """Installed external backends + in-tree system roles (backends.go
+    ListSystemBackends)."""
+    out = [{"name": role, "system": True} for role in sorted(ROLES)]
+    if backends_path and os.path.isdir(backends_path):
+        for entry in sorted(os.listdir(backends_path)):
+            mpath = os.path.join(backends_path, entry, METADATA)
+            if os.path.isfile(mpath):
+                with open(mpath) as f:
+                    meta = json.load(f)
+                meta.setdefault("name", entry)
+                meta["system"] = False
+                out.append(meta)
+    return out
+
+
+def resolve_backend_dir(backends_path: str, name: str) -> str | None:
+    """name/alias/meta → runnable backend dir (one with run.sh), or None for
+    in-tree roles."""
+    if not backends_path:
+        return None
+    direct = os.path.join(backends_path, name)
+    meta_file = os.path.join(direct, METADATA)
+    if os.path.isfile(meta_file):
+        with open(meta_file) as f:
+            meta = json.load(f)
+        target = meta.get("meta_backend_for")
+        if target:
+            return resolve_backend_dir(backends_path, target)
+        if os.path.isfile(os.path.join(direct, "run.sh")):
+            return direct
+    # alias scan
+    if os.path.isdir(backends_path):
+        for entry in os.listdir(backends_path):
+            mpath = os.path.join(backends_path, entry, METADATA)
+            if os.path.isfile(mpath):
+                with open(mpath) as f:
+                    meta = json.load(f)
+                if meta.get("alias") == name and os.path.isfile(
+                        os.path.join(backends_path, entry, "run.sh")):
+                    return os.path.join(backends_path, entry)
+    return None
+
+
+def delete_backend(backends_path: str, name: str):
+    """Remove an installed backend (and a meta alias dir pointing at it)."""
+    target = os.path.join(backends_path, name)
+    if not os.path.isdir(target):
+        raise KeyError(f"backend {name!r} is not installed")
+    if not os.path.isfile(os.path.join(target, METADATA)):
+        raise KeyError(f"{name!r} has no metadata — refusing to delete")
+    with open(os.path.join(target, METADATA)) as f:
+        meta = json.load(f)
+    shutil.rmtree(target)
+    concrete = meta.get("meta_backend_for")
+    if concrete and os.path.isdir(os.path.join(backends_path, concrete)):
+        shutil.rmtree(os.path.join(backends_path, concrete))
+
+
+class BackendGalleryService:
+    """Serialized backend-install job queue with UUID status map (mirrors
+    services/gallery.go's model job queue)."""
+
+    def __init__(self, gallery: BackendGallery, backends_path: str):
+        self.gallery = gallery
+        self.backends_path = backends_path
+        self._jobs: "queue.Queue[tuple[str, str]]" = queue.Queue()
+        self.status: dict[str, dict] = {}
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+
+    def start(self):
+        if self._thread:
+            return
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        self._jobs.put(("", ""))
+        if self._thread:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def submit(self, name: str) -> str:
+        job_id = uuid.uuid4().hex
+        self.status[job_id] = {"state": "queued", "backend": name,
+                               "progress": 0.0, "error": ""}
+        self._jobs.put((job_id, name))
+        return job_id
+
+    def _loop(self):
+        while not self._stop.is_set():
+            job_id, name = self._jobs.get()
+            if not job_id:
+                continue
+            st = self.status[job_id]
+            st["state"] = "processing"
+
+            def progress(done, total, st=st):
+                st["progress"] = done / total if total else 0.0
+
+            try:
+                path = install_backend(self.gallery, name,
+                                       self.backends_path, progress=progress)
+                st.update(state="done", progress=1.0, path=path)
+            except Exception as e:
+                st.update(state="error", error=f"{type(e).__name__}: {e}")

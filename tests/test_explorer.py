@@ -1,0 +1,134 @@
+"""Explorer (federation dashboard + discovery crawler) tests."""
+import json
+import threading
+
+import pytest
+
+
+def test_database_roundtrip(tmp_path):
+    from localai_tpu.explorer import Database, NetworkData
+
+    db = Database(str(tmp_path / "pool.json"))
+    db.set("tok1", NetworkData(name="n1", url="http://a", description="d"))
+    db.set("tok2", NetworkData(name="n2", url="http://b"))
+    assert db.token_list() == ["tok1", "tok2"]
+    assert db.get("tok1").name == "n1"
+    db.delete("tok1")
+    assert db.token_list() == ["tok2"]
+    # second instance sees the same file state (flock + reload semantics)
+    db2 = Database(str(tmp_path / "pool.json"))
+    assert db2.get("tok2").url == "http://b"
+
+
+def test_database_concurrent_writers(tmp_path):
+    from localai_tpu.explorer import Database, NetworkData
+
+    path = str(tmp_path / "pool.json")
+    db = Database(path)
+
+    def writer(i):
+        Database(path).set(f"tok{i}", NetworkData(name=f"n{i}", url="u"))
+
+    ts = [threading.Thread(target=writer, args=(i,)) for i in range(8)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert len(db.token_list()) == 8
+
+
+@pytest.fixture()
+def fake_lb():
+    """Minimal federated-LB lookalike serving /federation/workers."""
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    workers = [{"url": "http://w1:8080", "healthy": True},
+               {"url": "http://w2:8080", "healthy": True}]
+
+    class H(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            if self.path == "/federation/workers":
+                body = json.dumps(workers).encode()
+                self.send_response(200)
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self.send_response(404)
+                self.end_headers()
+
+    srv = HTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+
+
+def test_discovery_sync_and_eviction(tmp_path, fake_lb):
+    from localai_tpu.explorer import Database, DiscoveryServer, NetworkData
+
+    db = Database(str(tmp_path / "pool.json"))
+    db.set("good", NetworkData(name="good", url=fake_lb))
+    db.set("dead", NetworkData(name="dead", url="http://127.0.0.1:9"))
+    ds = DiscoveryServer(db, threshold=2, timeout=1.0)
+
+    ds.sync_once()
+    good = db.get("good")
+    assert good.clusters[0]["workers"] == ["http://w1:8080", "http://w2:8080"]
+    assert good.failures == 0
+    assert db.get("dead").failures == 1
+
+    ds.sync_once()   # second failure → evicted
+    assert db.get("dead") is None
+    assert db.get("good") is not None
+
+
+def test_explorer_http_routes(tmp_path, fake_lb):
+    import asyncio
+    import socket
+    import time
+
+    import requests
+    from aiohttp import web
+
+    from localai_tpu.explorer import Database, build_explorer_app
+
+    db = Database(str(tmp_path / "pool.json"))
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    loop = asyncio.new_event_loop()
+
+    def run():
+        asyncio.set_event_loop(loop)
+        runner = web.AppRunner(build_explorer_app(db))
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, "127.0.0.1", port)
+        loop.run_until_complete(site.start())
+        loop.run_forever()
+
+    threading.Thread(target=run, daemon=True).start()
+    base = f"http://127.0.0.1:{port}"
+    for _ in range(50):
+        try:
+            requests.get(base + "/", timeout=1)
+            break
+        except requests.ConnectionError:
+            time.sleep(0.1)
+    try:
+        page = requests.get(base + "/", timeout=5)
+        assert "Federated networks" in page.text
+        r = requests.post(base + "/network/add", json={
+            "name": "mynet", "url": fake_lb, "description": "test"},
+            timeout=5)
+        assert r.status_code == 200
+        # duplicate rejected
+        r = requests.post(base + "/network/add", json={"url": fake_lb},
+                          timeout=5)
+        assert r.status_code == 409
+        nets = requests.get(base + "/networks", timeout=5).json()
+        assert len(nets) == 1 and nets[0]["name"] == "mynet"
+        # missing url rejected
+        assert requests.post(base + "/network/add", json={},
+                             timeout=5).status_code == 400
+    finally:
+        loop.call_soon_threadsafe(loop.stop)

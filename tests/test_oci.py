@@ -1,0 +1,263 @@
+"""OCI client (localai_tpu/oci) against a local in-process registry —
+zero-egress verification of the pull/unpack paths the backend gallery and
+`oci://`/`ollama://` downloader schemes use."""
+import gzip
+import hashlib
+import io
+import json
+import os
+import tarfile
+import threading
+
+import pytest
+
+
+def _tar_layer(files: dict[str, bytes], gz=True) -> bytes:
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tf:
+        for name, data in files.items():
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    raw = buf.getvalue()
+    return gzip.compress(raw) if gz else raw
+
+
+def _digest(b: bytes) -> str:
+    return "sha256:" + hashlib.sha256(b).hexdigest()
+
+
+class _FakeRegistry:
+    """Tiny distribution-spec server: manifests + blobs, optional token auth."""
+
+    def __init__(self, auth=False):
+        self.blobs: dict[str, bytes] = {}
+        self.manifests: dict[tuple[str, str], bytes] = {}
+        self.auth = auth
+        self.requests = []
+
+    def add_image(self, repo: str, tag: str, layers: list[tuple[bytes, str]]):
+        entries = []
+        for data, mt in layers:
+            d = _digest(data)
+            self.blobs[d] = data
+            entries.append({"digest": d, "mediaType": mt, "size": len(data)})
+        manifest = json.dumps({
+            "schemaVersion": 2,
+            "mediaType": "application/vnd.oci.image.manifest.v1+json",
+            "layers": entries,
+        }).encode()
+        self.manifests[(repo, tag)] = manifest
+        return _digest(manifest)
+
+    def serve(self):
+        from http.server import BaseHTTPRequestHandler, HTTPServer
+
+        reg = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                reg.requests.append(self.path)
+                if reg.auth and self.path.startswith("/v2/") and \
+                        "token" not in self.headers.get("Authorization", ""):
+                    self.send_response(401)
+                    self.send_header(
+                        "WWW-Authenticate",
+                        f'Bearer realm="http://{self.server.server_address[0]}'
+                        f':{self.server.server_address[1]}/token",'
+                        f'service="fake",scope="pull"')
+                    self.end_headers()
+                    return
+                if self.path.startswith("/token"):
+                    body = json.dumps({"token": "token-abc"}).encode()
+                    self.send_response(200)
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                parts = self.path.split("/")
+                if "manifests" in parts:
+                    i = parts.index("manifests")
+                    repo, ref = "/".join(parts[2:i]), parts[i + 1]
+                    m = reg.manifests.get((repo, ref))
+                    if m is None and ref.startswith("sha256:"):
+                        m = next((v for v in reg.manifests.values()
+                                  if _digest(v) == ref), None)
+                    if m is None:
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "application/vnd.oci.image.manifest.v1+json")
+                    self.end_headers()
+                    self.wfile.write(m)
+                    return
+                if "blobs" in parts:
+                    i = parts.index("blobs")
+                    blob = reg.blobs.get(parts[i + 1])
+                    if blob is None:
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(blob)))
+                    self.end_headers()
+                    self.wfile.write(blob)
+                    return
+                self.send_response(404)
+                self.end_headers()
+
+        srv = HTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return srv
+
+
+@pytest.fixture()
+def registry():
+    reg = _FakeRegistry()
+    srv = reg.serve()
+    host = f"127.0.0.1:{srv.server_address[1]}"
+    yield reg, host
+    srv.shutdown()
+
+
+def test_parse_refs():
+    from localai_tpu.oci import parse_ollama_ref, parse_ref
+
+    assert parse_ref("oci://quay.io/org/img:v1") == ("quay.io", "org/img", "v1")
+    assert parse_ref("oci://host/repo") == ("host", "repo", "latest")
+    assert parse_ollama_ref("ollama://gemma:2b") == (
+        "registry.ollama.ai", "library/gemma", "2b")
+    assert parse_ollama_ref("ollama://org/m") == (
+        "registry.ollama.ai", "org/m", "latest")
+
+
+def test_pull_image(registry, tmp_path):
+    from localai_tpu.oci import pull_image
+
+    reg, host = registry
+    layer1 = _tar_layer({"run.sh": b"#!/bin/sh\necho hi\n"})
+    layer2 = _tar_layer({"sub/data.txt": b"payload"})
+    reg.add_image("org/backend", "v1", [
+        (layer1, "application/vnd.oci.image.layer.v1.tar+gzip"),
+        (layer2, "application/vnd.oci.image.layer.v1.tar+gzip")])
+    dest = str(tmp_path / "img")
+    pull_image(f"oci://{host}/org/backend:v1", dest)
+    assert (tmp_path / "img" / "run.sh").read_bytes().startswith(b"#!/bin/sh")
+    assert (tmp_path / "img" / "sub" / "data.txt").read_text() == "payload"
+
+
+def test_pull_image_with_token_auth(tmp_path):
+    from localai_tpu.oci import pull_image
+
+    reg = _FakeRegistry(auth=True)
+    srv = reg.serve()
+    host = f"127.0.0.1:{srv.server_address[1]}"
+    try:
+        layer = _tar_layer({"f": b"x"})
+        reg.add_image("r/i", "t", [
+            (layer, "application/vnd.oci.image.layer.v1.tar+gzip")])
+        pull_image(f"oci://{host}/r/i:t", str(tmp_path / "o"))
+        assert (tmp_path / "o" / "f").read_text() == "x"
+    finally:
+        srv.shutdown()
+
+
+def test_pull_rejects_corrupt_blob(registry, tmp_path):
+    from localai_tpu.oci import OCIError, pull_image
+
+    reg, host = registry
+    layer = _tar_layer({"f": b"x"})
+    reg.add_image("r/i", "t", [
+        (layer, "application/vnd.oci.image.layer.v1.tar+gzip")])
+    # corrupt the stored blob after the manifest recorded its digest
+    (d,) = list(reg.blobs)
+    reg.blobs[d] = reg.blobs[d] + b"tamper"
+    with pytest.raises(OCIError, match="digest mismatch"):
+        pull_image(f"oci://{host}/r/i:t", str(tmp_path / "o"))
+
+
+def test_extract_rejects_traversal(registry, tmp_path):
+    from localai_tpu.oci import OCIError, pull_image
+
+    reg, host = registry
+    evil = _tar_layer({"../../evil.txt": b"boom"})
+    reg.add_image("r/evil", "t", [
+        (evil, "application/vnd.oci.image.layer.v1.tar+gzip")])
+    with pytest.raises(OCIError, match="escapes"):
+        pull_image(f"oci://{host}/r/evil:t", str(tmp_path / "o"))
+    assert not (tmp_path / "evil.txt").exists()
+
+
+def test_whiteout_removes_file(registry, tmp_path):
+    from localai_tpu.oci import pull_image
+
+    reg, host = registry
+    l1 = _tar_layer({"old.txt": b"stale", "keep.txt": b"ok"})
+    l2 = _tar_layer({".wh.old.txt": b""})
+    reg.add_image("r/w", "t", [
+        (l1, "application/vnd.oci.image.layer.v1.tar+gzip"),
+        (l2, "application/vnd.oci.image.layer.v1.tar+gzip")])
+    pull_image(f"oci://{host}/r/w:t", str(tmp_path / "o"))
+    assert not (tmp_path / "o" / "old.txt").exists()
+    assert (tmp_path / "o" / "keep.txt").read_text() == "ok"
+
+
+def test_pull_ollama_model(registry, tmp_path):
+    from localai_tpu.oci import Registry, parse_ollama_ref  # noqa: F401
+    from localai_tpu.oci import pull_ollama_model
+
+    reg, host = registry
+    gguf = b"GGUF" + b"\x00" * 64
+    cfg = json.dumps({"config": True}).encode()
+    entries = []
+    for data, mt in ((cfg, "application/vnd.docker.container.image.v1+json"),
+                     (gguf, "application/vnd.ollama.image.model")):
+        d = _digest(data)
+        reg.blobs[d] = data
+        entries.append({"digest": d, "mediaType": mt, "size": len(data)})
+    reg.manifests[("library/fake", "1b")] = json.dumps({
+        "schemaVersion": 2,
+        "mediaType": "application/vnd.oci.image.manifest.v1+json",
+        "layers": entries}).encode()
+
+    # patch the registry host: pull_ollama_model hardwires registry.ollama.ai
+    import localai_tpu.oci as oci
+
+    orig = oci.OLLAMA_REGISTRY
+    oci.OLLAMA_REGISTRY = host
+    try:
+        dest = str(tmp_path / "model.gguf")
+        pull_ollama_model("ollama://fake:1b", dest)
+        assert open(dest, "rb").read(4) == b"GGUF"
+    finally:
+        oci.OLLAMA_REGISTRY = orig
+
+
+def test_unpack_oci_file(tmp_path):
+    from localai_tpu.oci import unpack_oci_file
+
+    layer = _tar_layer({"bin/tool": b"TOOL"})
+    manifest = json.dumps({
+        "schemaVersion": 2,
+        "layers": [{"digest": _digest(layer),
+                    "mediaType": "application/vnd.oci.image.layer.v1.tar+gzip",
+                    "size": len(layer)}]}).encode()
+    index = json.dumps({"manifests": [{"digest": _digest(manifest)}]}).encode()
+    tar_path = str(tmp_path / "img.tar")
+    with tarfile.open(tar_path, "w") as tf:
+        for name, data in (("index.json", index),
+                           ("blobs/" + _digest(manifest).replace(":", "/"),
+                            manifest),
+                           ("blobs/" + _digest(layer).replace(":", "/"),
+                            layer)):
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    out = str(tmp_path / "out")
+    unpack_oci_file(tar_path, out)
+    assert (tmp_path / "out" / "bin" / "tool").read_text() == "TOOL"
