@@ -166,6 +166,9 @@ def bench_serve(args, size: str, on_cpu: bool):
         "context_size": context,
         "parallel": args.slots,
         "dtype": dtype,
+        # int8 KV on the quantized-weight geometries: the llama.cpp analog
+        # (cache_type q8_0) and what makes high slot counts fit HBM
+        "cache_type_k": "int8" if dtype in ("int8", "int4") else "",
         "prefill_buckets": [128, min(512, context)],
         "parameters": {"model": ckpt},
     })
@@ -340,7 +343,9 @@ def main(argv=None):
     p.add_argument("--dtype", default=None,
                    help="override weights dtype (default: int8 for 8b, else bf16)")
     p.add_argument("--cpu", action="store_true", help="force CPU (local smoke)")
-    p.add_argument("--slots", type=int, default=8)
+    p.add_argument("--slots", type=int, default=None,
+                   help="concurrent streams; default 16 on the int8-KV "
+                        "geometries (8b), 8 on dense-KV ones")
     p.add_argument("--prompt-len", type=int, default=120)
     p.add_argument("--decode-steps", type=int, default=128)
     p.add_argument("--windows", type=int, default=5)
@@ -349,6 +354,11 @@ def main(argv=None):
 
     on_cpu, probe_error, device_kind = probe_accelerator(args)
     size = args.size or ("tiny" if on_cpu else "8b")
+    if args.slots is None:
+        # int8-KV geometries halve per-slot HBM → double the slot count;
+        # dense-KV geometries keep the old footprint
+        dtype = args.dtype or ("int8" if size == "8b" else "bfloat16")
+        args.slots = 16 if dtype in ("int8", "int4") else 8
 
     if args.mode == "serve":
         # the parent process stays JAX-free: the backend subprocess owns the

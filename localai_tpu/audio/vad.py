@@ -24,6 +24,32 @@ class VADConfig:
     hangover_ms: float = 150.0        # keep speech alive over short dips
 
 
+def frames_to_segments(active, hang: int, min_frames: int
+                       ) -> list[tuple[int, int]]:
+    """Active-frame mask → merged (start, end) frame spans with `hang`
+    frames of hangover and a minimum span length (shared by the energy and
+    model detectors)."""
+    segments = []
+    start, gap = None, 0
+    for i, a in enumerate(active):
+        if a:
+            if start is None:
+                start = i
+            gap = 0
+        elif start is not None:
+            gap += 1
+            if gap > hang:
+                end = i - gap + 1
+                if end - start >= min_frames:
+                    segments.append((start, end))
+                start, gap = None, 0
+    if start is not None:
+        end = len(active)
+        if end - start >= min_frames:
+            segments.append((start, end))
+    return segments
+
+
 def detect_segments(audio: np.ndarray, cfg: VADConfig | None = None
                     ) -> list[tuple[float, float]]:
     """mono f32 → [(start_s, end_s), ...] speech segments."""
@@ -43,26 +69,38 @@ def detect_segments(audio: np.ndarray, cfg: VADConfig | None = None
 
     hang = max(1, int(cfg.hangover_ms / cfg.frame_ms))
     min_frames = max(1, int(cfg.min_speech_ms / cfg.frame_ms))
-
-    segments = []
-    start = None
-    gap = 0
-    for i, a in enumerate(active):
-        if a:
-            if start is None:
-                start = i
-            gap = 0
-        elif start is not None:
-            gap += 1
-            if gap > hang:
-                end = i - gap + 1
-                if end - start >= min_frames:
-                    segments.append((start, end))
-                start, gap = None, 0
-    if start is not None:
-        end = n
-        if end - start >= min_frames:
-            segments.append((start, end))
-
+    segments = frames_to_segments(active, hang, min_frames)
     sec = cfg.frame_ms / 1000.0
     return [(round(s * sec, 3), round(e * sec, 3)) for s, e in segments]
+
+
+_model_params = None
+_model_params_loaded = False
+
+
+def detect_segments_auto(audio: np.ndarray) -> list[tuple[float, float]]:
+    """Model-based VAD (audio/nvad.py — the silero role) when the shipped
+    weights are present, adaptive-energy fallback otherwise. This is what
+    the VAD RPC serves. Weights are loaded once; a broken weight file logs a
+    warning instead of silently degrading on every call."""
+    global _model_params, _model_params_loaded
+    if not _model_params_loaded:
+        from localai_tpu.audio.nvad import load_params
+
+        _model_params = load_params()
+        _model_params_loaded = True
+    if _model_params is not None:
+        try:
+            from localai_tpu.audio.nvad import detect_segments_model
+
+            return [(round(s, 3), round(e, 3))
+                    for s, e in detect_segments_model(
+                        audio, params=_model_params)]
+        except Exception:
+            import logging
+
+            logging.getLogger("localai_tpu").warning(
+                "model VAD failed; falling back to energy VAD",
+                exc_info=True)
+            _model_params = None        # don't retry per call
+    return detect_segments(audio)

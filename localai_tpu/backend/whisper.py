@@ -3,6 +3,7 @@
 segments — plus the silero VAD backend's VAD RPC, vad.go:1-58)."""
 from __future__ import annotations
 
+import os
 import threading
 
 import grpc
@@ -18,8 +19,6 @@ class WhisperServicer(BackendServicer):
         self._lock = threading.Lock()
 
     def LoadModel(self, request, context):
-        import os
-
         with self._lock:
             if self.model is not None:
                 return pb.Result(success=True, message="already loaded")
@@ -39,7 +38,7 @@ class WhisperServicer(BackendServicer):
         if self.model is None:
             context.abort(grpc.StatusCode.FAILED_PRECONDITION, "no model")
         from localai_tpu.audio.pcm import read_wav
-        from localai_tpu.audio.vad import detect_segments
+        from localai_tpu.audio.vad import detect_segments_auto
 
         try:
             audio, _ = read_wav(request.dst, target_rate=16000)
@@ -48,7 +47,7 @@ class WhisperServicer(BackendServicer):
                           f"cannot read audio: {e}")
         # VAD-split → one whisper pass per speech segment (segments shape of
         # the reference's whisper_full segments)
-        spans = detect_segments(audio) or (
+        spans = detect_segments_auto(audio) or (
             [(0.0, len(audio) / 16000.0)] if len(audio) else [])
         resp = pb.TranscriptResult()
         texts = []
@@ -65,25 +64,49 @@ class WhisperServicer(BackendServicer):
         return resp
 
     def VAD(self, request, context):
-        from localai_tpu.audio.vad import detect_segments
+        from localai_tpu.audio.vad import detect_segments_auto
 
         audio = np.asarray(list(request.audio), np.float32)
         resp = pb.VADResponse()
-        for s, e in detect_segments(audio):
+        for s, e in detect_segments_auto(audio):
             resp.segments.append(pb.VADSegment(start=s, end=e))
         return resp
 
 
 class TTSServicer(BackendServicer):
-    """DSP TTS + sound generation (reference piper/bark role)."""
+    """Neural (VITS/MMS) or DSP TTS + sound generation (reference
+    piper/bark role, backend/go/piper/piper.go:1-49). LoadModel with a VITS
+    checkpoint dir arms the neural voice; without one the dependency-free
+    formant synthesizer serves the contract."""
+
+    def __init__(self):
+        self.voice = None
 
     def LoadModel(self, request, context):
+        self.voice = None            # a re-load must not keep a stale voice
+        model_dir = request.model
+        if request.model_path and model_dir and not os.path.isdir(model_dir):
+            model_dir = os.path.join(request.model_path, request.model)
+        if model_dir and os.path.isdir(model_dir):
+            from localai_tpu.models.vits import VitsTTS, is_vits_dir
+
+            if is_vits_dir(model_dir):
+                try:
+                    self.voice = VitsTTS(model_dir)
+                except Exception as e:
+                    return pb.Result(success=False,
+                                     message=f"{type(e).__name__}: {e}")
         return pb.Result(success=True, message="ok")
 
     def TTS(self, request, context):
         if not request.dst:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, "dst required")
         from localai_tpu.audio.pcm import write_wav
+
+        if self.voice is not None:
+            audio = self.voice.synthesize(request.text)
+            write_wav(request.dst, audio, self.voice.rate)
+            return pb.Result(success=True, message=request.dst)
         from localai_tpu.audio.tts import synthesize
 
         audio = synthesize(request.text, voice=request.voice or "default",
@@ -103,10 +126,10 @@ class TTSServicer(BackendServicer):
         return pb.Result(success=True, message=request.dst)
 
     def VAD(self, request, context):
-        from localai_tpu.audio.vad import detect_segments
+        from localai_tpu.audio.vad import detect_segments_auto
 
         audio = np.asarray(list(request.audio), np.float32)
         resp = pb.VADResponse()
-        for s, e in detect_segments(audio):
+        for s, e in detect_segments_auto(audio):
             resp.segments.append(pb.VADSegment(start=s, end=e))
         return resp
