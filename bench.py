@@ -356,8 +356,11 @@ def main(argv=None):
     size = args.size or ("tiny" if on_cpu else "8b")
     if args.slots is None:
         # int8-KV geometries halve per-slot HBM → double the slot count;
-        # dense-KV geometries keep the old footprint
+        # dense-KV geometries keep the old footprint. Mirror bench_serve's
+        # dtype resolution incl. the CPU float32 override.
         dtype = args.dtype or ("int8" if size == "8b" else "bfloat16")
+        if on_cpu:
+            dtype = args.dtype or "float32"
         args.slots = 16 if dtype in ("int8", "int4") else 8
 
     if args.mode == "serve":

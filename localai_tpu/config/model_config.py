@@ -90,6 +90,11 @@ class ModelConfig:
     n_draft: int = 0                 # draft tokens per step (0 = default 4)
     cache_type_k: str = ""           # KV cache storage: ""|bf16|int8|q8_0
     cache_type_v: str = ""           # (reference cache_type_k/v YAML keys)
+    mcp: dict = dataclasses.field(default_factory=dict)
+                                     # MCP servers {servers: [...], stdio:
+                                     # [...]} (reference config.MCP block)
+    agent: dict = dataclasses.field(default_factory=dict)
+                                     # agent loop knobs {max_iterations: N}
     pipeline: Pipeline = dataclasses.field(default_factory=Pipeline)
     known_usecases: list[str] = dataclasses.field(default_factory=list)
     # file this config came from (set by the loader)

@@ -1,0 +1,202 @@
+"""Minimal MCP (Model Context Protocol) client: stdio + HTTP transports.
+
+Reference: /root/reference/core/http/endpoints/openai/mcp.go:1-142 exposes
+`/mcp/v1/chat/completions` — the model config lists MCP servers, their tools
+are fetched once per model, and an agentic loop lets the LLM call them. This
+module is the protocol side: JSON-RPC 2.0 `initialize` / `tools/list` /
+`tools/call` over newline-delimited stdio (spawned command) or HTTP POST
+(streamable-http transport; single SSE-framed responses are unwrapped).
+"""
+from __future__ import annotations
+
+import json
+import subprocess
+import threading
+from typing import Any
+
+PROTOCOL_VERSION = "2024-11-05"
+
+
+class MCPError(RuntimeError):
+    pass
+
+
+class _StdioTransport:
+    """Newline-delimited JSON-RPC over a spawned server process."""
+
+    def __init__(self, command: str, env: dict | None = None):
+        import os
+        import shlex
+
+        full_env = dict(os.environ)
+        full_env.update(env or {})
+        self.proc = subprocess.Popen(
+            shlex.split(command), stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, env=full_env)
+        self._lock = threading.Lock()
+
+    def request(self, payload: dict, timeout: float = 30.0) -> dict | None:
+        import select
+
+        with self._lock:
+            if self.proc.poll() is not None:
+                raise MCPError("MCP server process exited")
+            self.proc.stdin.write(json.dumps(payload) + "\n")
+            self.proc.stdin.flush()
+            if "id" not in payload:      # notification: no response expected
+                return None
+            deadline = __import__("time").monotonic() + timeout
+            while True:
+                remain = deadline - __import__("time").monotonic()
+                if remain <= 0:
+                    raise MCPError(
+                        f"MCP server timed out after {timeout:.0f}s")
+                ready, _, _ = select.select([self.proc.stdout], [], [],
+                                            min(remain, 1.0))
+                if not ready:
+                    if self.proc.poll() is not None:
+                        raise MCPError("MCP server process exited")
+                    continue
+                line = self.proc.stdout.readline()
+                if not line:
+                    raise MCPError("MCP server closed the pipe")
+                try:
+                    msg = json.loads(line)
+                except ValueError:
+                    continue             # non-protocol stdout noise
+                # skip server-initiated notifications / mismatched replies
+                # (real servers log via notifications/message on stdout)
+                if msg.get("id") == payload["id"]:
+                    return msg
+
+    def close(self):
+        try:
+            self.proc.terminate()
+            self.proc.wait(timeout=3)
+        except Exception:
+            self.proc.kill()
+
+
+class _HttpTransport:
+    """JSON-RPC over HTTP POST (MCP streamable-http). A text/event-stream
+    reply containing one data: frame is unwrapped."""
+
+    def __init__(self, url: str, headers: dict | None = None):
+        self.url = url
+        self.headers = {"Content-Type": "application/json",
+                        "Accept": "application/json, text/event-stream"}
+        self.headers.update(headers or {})
+
+    def request(self, payload: dict, timeout: float = 30.0) -> dict | None:
+        import urllib.request
+
+        req = urllib.request.Request(
+            self.url, data=json.dumps(payload).encode(), headers=self.headers)
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            body = r.read().decode()
+            ctype = r.headers.get("Content-Type", "")
+        if "id" not in payload:
+            return None
+        if "text/event-stream" in ctype:
+            for line in body.splitlines():
+                if line.startswith("data:"):
+                    return json.loads(line[5:].strip())
+            raise MCPError("SSE response without a data frame")
+        return json.loads(body) if body else None
+
+    def close(self):
+        pass
+
+
+class MCPSession:
+    """One initialized MCP server connection with its tool list."""
+
+    def __init__(self, name: str, transport):
+        self.name = name
+        self.transport = transport
+        self._next_id = 0
+        self.tools: list[dict] = []
+        self._initialize()
+
+    def _rpc(self, method: str, params: dict | None = None,
+             notify: bool = False):
+        payload: dict[str, Any] = {"jsonrpc": "2.0", "method": method}
+        if params is not None:
+            payload["params"] = params
+        if not notify:
+            self._next_id += 1
+            payload["id"] = self._next_id
+        resp = self.transport.request(payload)
+        if notify:
+            return None
+        if resp is None:
+            raise MCPError(f"{method}: no response")
+        if "error" in resp:
+            raise MCPError(f"{method}: {resp['error']}")
+        return resp.get("result", {})
+
+    def _initialize(self):
+        self._rpc("initialize", {
+            "protocolVersion": PROTOCOL_VERSION,
+            "capabilities": {},
+            "clientInfo": {"name": "localai-tpu", "version": "1"},
+        })
+        self._rpc("notifications/initialized", {}, notify=True)
+        self.tools = self._rpc("tools/list", {}).get("tools", [])
+
+    def call_tool(self, name: str, arguments: dict) -> str:
+        result = self._rpc("tools/call", {"name": name,
+                                          "arguments": arguments})
+        parts = []
+        for item in result.get("content", []):
+            if item.get("type") == "text":
+                parts.append(item.get("text", ""))
+            else:
+                parts.append(json.dumps(item))
+        if result.get("isError"):
+            raise MCPError("; ".join(parts) or "tool error")
+        return "\n".join(parts)
+
+    def close(self):
+        self.transport.close()
+
+
+def sessions_from_config(mcp_cfg: dict) -> list[MCPSession]:
+    """Model-config MCP block → initialized sessions.
+
+    Shape (reference config.MCP, remote+stdio YAML blocks):
+      mcp:
+        servers:            # remote
+          - name: search
+            url: http://host/mcp
+            headers: {Authorization: ...}
+        stdio:              # local commands
+          - name: calc
+            command: python /path/server.py
+            env: {KEY: VAL}
+    """
+    sessions = []
+    for entry in mcp_cfg.get("servers") or []:
+        sessions.append(MCPSession(
+            entry.get("name", entry.get("url", "remote")),
+            _HttpTransport(entry["url"], entry.get("headers"))))
+    for entry in mcp_cfg.get("stdio") or []:
+        sessions.append(MCPSession(
+            entry.get("name", "stdio"),
+            _StdioTransport(entry["command"], entry.get("env"))))
+    return sessions
+
+
+def tools_as_openai(sessions: list[MCPSession]) -> tuple[list[dict], dict]:
+    """Sessions' tools → OpenAI `tools` array + {tool_name: session} map."""
+    tools, owner = [], {}
+    for s in sessions:
+        for t in s.tools:
+            tools.append({"type": "function", "function": {
+                "name": t["name"],
+                "description": t.get("description", ""),
+                "parameters": t.get("inputSchema", {"type": "object"}),
+            }})
+            owner[t["name"]] = s
+    return tools, owner

@@ -290,36 +290,155 @@ class WhisperModel:
 
             self.tokenizer = HFTok.from_file(tok_path)
 
-    def transcribe_tokens(self, audio: np.ndarray, max_tokens: int = 224
-                          ) -> list[int]:
-        """16 kHz mono f32 → decoded token ids (greedy, one 30 s chunk)."""
+    def transcribe_tokens(self, audio: np.ndarray, max_tokens: int = 224,
+                          beam_size: int = 5,
+                          temperatures=(0.0, 0.2, 0.4, 0.6, 0.8, 1.0),
+                          logprob_threshold: float = -1.0,
+                          compression_threshold: float = 2.4,
+                          seed: int = 0) -> list[int]:
+        """16 kHz mono f32 → decoded token ids, one 30 s chunk.
+
+        Decode strategy mirrors whisper.cpp / faster-whisper (the reference's
+        transcription engines, backend/go/whisper + faster-whisper
+        backend.py): beam search at temperature 0, then temperature-fallback
+        resampling whenever the result looks degenerate (average logprob
+        below `logprob_threshold` or zlib compression ratio above
+        `compression_threshold` — the repetition-loop detector)."""
         from localai_tpu.audio.mel import log_mel_spectrogram
 
         cfg = self.cfg
         mel = log_mel_spectrogram(audio, n_mels=cfg.num_mel_bins)[None]
         enc = self._encode(self.params, mel=jnp.asarray(mel))
         ck, cv = self._cross(self.params, enc_out=enc)
-        kc, vc = init_self_cache(cfg, 1)
+        max_tokens = min(max_tokens, cfg.max_target_positions - 1)
 
+        best: list[int] = []
+        for ti, temp in enumerate(temperatures):
+            if temp == 0.0 and beam_size > 1:
+                ids, avg_lp = self._beam_decode(ck, cv, beam_size, max_tokens)
+            else:
+                ids, avg_lp = self._sample_decode(ck, cv, temp, max_tokens,
+                                                  seed + ti)
+            best = ids
+            if avg_lp < logprob_threshold:
+                continue
+            if self.tokenizer is not None and len(ids) >= 8:
+                import zlib
+
+                text = self.tokenizer.decode(ids, skip_special_tokens=True)
+                raw = text.encode()
+                if raw and len(raw) / len(zlib.compress(raw)) > \
+                        compression_threshold:
+                    continue
+            break
+        return best
+
+    def _logprobs_host(self, logits) -> np.ndarray:
+        """[B, V] logits → suppress-masked log-softmax on host."""
+        lg = np.asarray(logits, np.float64)
+        suppress = np.array(list(self.cfg.suppress_tokens), np.int64)
+        if suppress.size:
+            lg[:, suppress] = -np.inf
+        lg = lg - lg.max(axis=-1, keepdims=True)
+        lse = np.log(np.exp(lg).sum(axis=-1, keepdims=True))
+        return lg - lse
+
+    def _sample_decode(self, ck, cv, temp: float, max_tokens: int, seed: int
+                       ) -> tuple[list[int], float]:
+        """Single-stream decode: argmax at temp 0, multinomial otherwise.
+        Returns (ids, avg logprob incl. the end token)."""
+        cfg = self.cfg
+        rng = np.random.default_rng(seed)
+        kc, vc = init_self_cache(cfg, 1)
         forced = dict(cfg.forced_ids)
-        suppress = np.array(list(cfg.suppress_tokens), np.int64)
         ids = [cfg.decoder_start_token_id]
-        for i in range(min(max_tokens, cfg.max_target_positions - 1)):
+        sum_lp, n_lp = 0.0, 0
+        for i in range(max_tokens):
             logits, kc, vc = self._step(
                 self.params, tokens=jnp.array([ids[-1]], jnp.int32),
                 lengths=jnp.array([i], jnp.int32),
                 cross_k=ck, cross_v=cv, kc=kc, vc=vc)
+            lp = self._logprobs_host(logits)[0]
             if i + 1 in forced:
                 nxt = forced[i + 1]
+            elif temp > 0:
+                p = np.exp((lp - lp.max()) / temp)
+                p = p / p.sum()
+                nxt = int(rng.choice(len(p), p=p))
             else:
-                lg = np.asarray(logits[0])
-                if suppress.size:
-                    lg[suppress] = -np.inf
-                nxt = int(lg.argmax())
+                nxt = int(lp.argmax())
+            sum_lp += float(lp[nxt]) if np.isfinite(lp[nxt]) else 0.0
+            n_lp += 1
             if nxt == cfg.eos_token_id:
                 break
             ids.append(nxt)
-        return ids[1:]
+        return ids[1:], (sum_lp / max(n_lp, 1))
+
+    def _beam_decode(self, ck, cv, beam_size: int, max_tokens: int
+                     ) -> tuple[list[int], float]:
+        """Batched beam search over the jitted decode step: the whole beam
+        is ONE device batch; beams reorder by gathering the self-attn cache
+        on the parent index. Finished hypotheses leave the beam; selection is
+        by length-normalized logprob (the whisper.cpp/HF default)."""
+        cfg = self.cfg
+        B = beam_size
+        kc, vc = init_self_cache(cfg, B)
+        ckb = jnp.repeat(ck, B, axis=1)
+        cvb = jnp.repeat(cv, B, axis=1)
+        forced = dict(cfg.forced_ids)
+
+        seqs = [[cfg.decoder_start_token_id] for _ in range(B)]
+        # only beam 0 is live at step 0 (all beams start identical)
+        cum = np.full(B, -np.inf)
+        cum[0] = 0.0
+        finished: list[tuple[list[int], float]] = []
+
+        for i in range(max_tokens):
+            logits, kc, vc = self._step(
+                self.params,
+                tokens=jnp.asarray([s[-1] for s in seqs], jnp.int32),
+                lengths=jnp.full((B,), i, jnp.int32),
+                cross_k=ckb, cross_v=cvb, kc=kc, vc=vc)
+            lp = self._logprobs_host(logits)            # [B, V]
+            if i + 1 in forced:
+                tok = forced[i + 1]
+                cum = cum + lp[:, tok]
+                for s in seqs:
+                    s.append(tok)
+                continue
+            total = cum[:, None] + lp                   # [B, V]
+            flat = total.ravel()
+            order = np.argsort(flat)[::-1][: 2 * B]
+            new_seqs, new_cum, parents = [], [], []
+            for fi in order:
+                parent, tok = divmod(int(fi), lp.shape[1])
+                score = float(flat[fi])
+                if not np.isfinite(score):
+                    continue
+                if tok == cfg.eos_token_id:
+                    finished.append((seqs[parent][1:], score / (i + 2)))
+                    continue
+                new_seqs.append(seqs[parent] + [tok])
+                new_cum.append(score)
+                parents.append(parent)
+                if len(new_seqs) == B:
+                    break
+            if not new_seqs or len(finished) >= B:
+                break
+            while len(new_seqs) < B:                    # pad dead beams
+                new_seqs.append(list(new_seqs[0]))
+                new_cum.append(-np.inf)
+                parents.append(parents[0])
+            idx = jnp.asarray(parents)
+            kc = kc[:, idx]
+            vc = vc[:, idx]
+            seqs, cum = new_seqs, np.asarray(new_cum)
+
+        if not finished:
+            j = int(np.argmax(cum))
+            finished.append((seqs[j][1:], float(cum[j]) / (len(seqs[j]) + 1)))
+        finished.sort(key=lambda t: -t[1])
+        return finished[0]
 
     def transcribe(self, audio: np.ndarray, rate: int = 16000) -> str:
         if rate != 16000:

@@ -65,6 +65,10 @@ class API:
         r.add_post("/chat/completions", self._chat)
         r.add_post("/v1/completions", self._completions)
         r.add_post("/completions", self._completions)
+        r.add_post("/v1/edits", self._edits)
+        # MCP agentic chat (reference endpoints/openai/mcp.go:1-142)
+        r.add_post("/mcp/v1/chat/completions", self._mcp_chat)
+        r.add_post("/mcp/v1/completions", self._mcp_chat)
         r.add_post("/v1/embeddings", self._embeddings)
         r.add_post("/embeddings", self._embeddings)
         r.add_post("/v1/rerank", self._rerank)
@@ -106,6 +110,8 @@ class API:
         r.add_post("/v1/sound-generation", self._sound_generation)
         self.gallery_service = None  # wired by run_server when galleries set
         self.backend_gallery_service = None  # ditto (backend registry)
+        self._mcp_sessions: dict[str, list] = {}   # model → MCP sessions
+        self._mcp_lock = threading.Lock()
 
     # ------------------------------------------------------------ middleware
 
@@ -464,6 +470,160 @@ class API:
             })
         finally:
             handle.mark_idle()
+
+    async def _edits(self, request):
+        """POST /v1/edits — legacy OpenAI edit API (reference
+        endpoints/openai/edit.go, routed at routes/openai.go:56): apply
+        `instruction` to `input` via the completion path."""
+        body = await request.json()
+        cfg = self._resolve(body)
+        instruction = body.get("instruction", "")
+        if not instruction:
+            raise web.HTTPBadRequest(text="instruction required")
+        inp = body.get("input", "")
+        prompt = (f"Text: {inp}\nInstruction: {instruction}\n"
+                  f"Edited text:")
+        sub = {"model": cfg.name, "prompt": prompt}
+        for f in _SAMPLING_FIELDS + ("max_tokens",):
+            if f in body:
+                sub[f] = body[f]
+        resp = await self._loopback("/v1/completions", sub)
+        return web.json_response({
+            "object": "edit",
+            "created": int(time.time()),
+            "choices": [{"index": i, "text": c.get("text", "")}
+                        for i, c in enumerate(resp.get("choices", []))],
+            "usage": resp.get("usage", {}),
+        })
+
+    async def _loopback(self, path: str, body: dict) -> dict:
+        """POST to our own API (the reference's MCP agent does the same —
+        mcp.go hands the local API address to the agent loop)."""
+        import aiohttp
+
+        headers = {}
+        if self.cfg.api_keys:
+            headers["Authorization"] = f"Bearer {self.cfg.api_keys[0]}"
+        url = f"http://{self.cfg.address}{path}"
+        async with aiohttp.ClientSession() as s:
+            async with s.post(url, json=body, headers=headers,
+                              timeout=aiohttp.ClientTimeout(total=600)) as r:
+                if r.status != 200:
+                    raise web.HTTPInternalServerError(
+                        text=f"loopback {path} failed: {await r.text()}")
+                return await r.json()
+
+    def _mcp_sessions_for(self, cfg):
+        from localai_tpu.mcp import sessions_from_config
+
+        with self._mcp_lock:
+            cached = self._mcp_sessions.get(cfg.name)
+        if cached is not None:
+            return cached
+        # session setup (process spawn + initialize handshake) happens
+        # OUTSIDE the lock: a wedged server must not block other models
+        sessions = sessions_from_config(cfg.mcp)
+        with self._mcp_lock:
+            existing = self._mcp_sessions.get(cfg.name)
+            if existing is not None:     # lost the race: keep the first set
+                for s in sessions:
+                    s.close()
+                return existing
+            self._mcp_sessions[cfg.name] = sessions
+            return sessions
+
+    def _mcp_evict(self, name: str):
+        """Drop (and close) a model's cached MCP sessions — called when a
+        transport dies so the next request reconnects instead of failing
+        forever."""
+        with self._mcp_lock:
+            sessions = self._mcp_sessions.pop(name, None)
+        for s in sessions or []:
+            try:
+                s.close()
+            except Exception:
+                pass
+
+    async def _mcp_chat(self, request):
+        """POST /mcp/v1/chat/completions — agentic chat with the model
+        config's MCP servers' tools (reference mcp.go:1-142): the model's
+        tool_calls are executed against the MCP sessions and fed back until
+        it answers in prose (or the iteration budget runs out)."""
+        body = await request.json()
+        cfg = self._resolve(body)
+        if not cfg.mcp:
+            raise web.HTTPBadRequest(
+                text=f"model {cfg.name!r} has no MCP servers configured")
+        from localai_tpu.mcp import tools_as_openai
+
+        try:
+            sessions = await asyncio.to_thread(self._mcp_sessions_for, cfg)
+        except Exception as e:
+            raise web.HTTPInternalServerError(
+                text=f"MCP session setup failed: {e}")
+        tools, owner = tools_as_openai(sessions)
+        if not tools:
+            raise web.HTTPInternalServerError(
+                text="no tools offered by the configured MCP servers")
+
+        messages = list(body.get("messages") or [])
+        if not messages and body.get("prompt"):
+            messages = [{"role": "user", "content": body["prompt"]}]
+        max_iter = int((cfg.agent or {}).get("max_iterations", 3))
+        last = {}
+        for it in range(max_iter):
+            sub = {"model": cfg.name, "messages": messages}
+            for f in _SAMPLING_FIELDS + ("max_tokens",):
+                if f in body:
+                    sub[f] = body[f]
+            if it < max_iter - 1:
+                sub["tools"] = tools   # final round: force a prose answer
+                # a truncated tool-call JSON cannot parse — give the
+                # grammar-constrained round enough budget to close the braces
+                sub["max_tokens"] = max(int(sub.get("max_tokens") or 0), 128)
+            last = await self._loopback("/v1/chat/completions", sub)
+            choice = (last.get("choices") or [{}])[0]
+            msg = choice.get("message", {})
+            calls = msg.get("tool_calls")
+            if not calls:
+                break
+            # the chat template renders only role+content, so serialize the
+            # calls INTO the content — the next round's prompt must show
+            # which tool was called with what and which result is whose
+            call_desc = "; ".join(
+                f"{c.get('function', {}).get('name', '?')}"
+                f"({c.get('function', {}).get('arguments', '')})"
+                for c in calls)
+            messages.append({"role": "assistant", "tool_calls": calls,
+                             "content": f"[tool calls] {call_desc}"})
+            from localai_tpu.mcp import MCPError
+
+            for call in calls:
+                fn = call.get("function", {})
+                name = fn.get("name", "")
+                try:
+                    args = json.loads(fn.get("arguments") or "{}")
+                except ValueError:
+                    args = {}
+                sess = owner.get(name)
+                if sess is None:
+                    result = f"error: unknown tool {name!r}"
+                else:
+                    try:
+                        result = await asyncio.to_thread(
+                            sess.call_tool, name, args)
+                    except MCPError as e:
+                        # transport died: evict so the NEXT request
+                        # reconnects instead of failing forever
+                        self._mcp_evict(cfg.name)
+                        result = f"error: {e}"
+                    except Exception as e:
+                        result = f"error: {e}"
+                messages.append({"role": "tool",
+                                 "tool_call_id": call.get("id", name),
+                                 "name": name,
+                                 "content": f"[{name}] {result}"})
+        return web.json_response(last)
 
     async def _detection(self, request):
         """POST /v1/detection {model, image: base64|data-URI|file path} →

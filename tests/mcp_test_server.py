@@ -1,0 +1,53 @@
+"""Tiny MCP stdio server for tests: one `add` tool; records calls to the
+file given in argv[1] (newline-delimited JSON-RPC per the MCP stdio
+transport)."""
+import json
+import sys
+
+
+def main():
+    log_path = sys.argv[1] if len(sys.argv) > 1 else None
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        msg = json.loads(line)
+        method = msg.get("method", "")
+        if "id" not in msg:
+            continue                       # notification
+        if method == "initialize":
+            result = {"protocolVersion": "2024-11-05",
+                      "capabilities": {"tools": {}},
+                      "serverInfo": {"name": "test-mcp", "version": "1"}}
+        elif method == "tools/list":
+            result = {"tools": [{
+                "name": "add",
+                "description": "Add two integers",
+                "inputSchema": {"type": "object", "properties": {
+                    "a": {"type": "integer"}, "b": {"type": "integer"}},
+                    "required": ["a", "b"]},
+            }]}
+        elif method == "tools/call":
+            params = msg.get("params", {})
+            if log_path:
+                with open(log_path, "a") as f:
+                    f.write(json.dumps(params) + "\n")
+            args = params.get("arguments", {})
+            try:
+                total = int(args.get("a", 0)) + int(args.get("b", 0))
+                result = {"content": [{"type": "text", "text": str(total)}]}
+            except Exception as e:
+                result = {"content": [{"type": "text", "text": str(e)}],
+                          "isError": True}
+        else:
+            print(json.dumps({"jsonrpc": "2.0", "id": msg["id"],
+                              "error": {"code": -32601,
+                                        "message": "unknown method"}}),
+                  flush=True)
+            continue
+        print(json.dumps({"jsonrpc": "2.0", "id": msg["id"],
+                          "result": result}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
