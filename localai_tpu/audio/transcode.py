@@ -1,0 +1,50 @@
+"""Audio transcode helper — the ffmpeg shell-out role.
+
+Reference: /root/reference/pkg/utils/ffmpeg.go converts arbitrary uploads to
+16 kHz mono WAV by shelling out to ffmpeg. Same strategy here: WAV handled
+natively (wave + polyphase resample), anything else delegated to an ffmpeg
+binary when one is on PATH; otherwise a clear error names the missing
+dependency instead of mis-decoding.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import tempfile
+
+import numpy as np
+
+
+def ffmpeg_available() -> bool:
+    return shutil.which("ffmpeg") is not None
+
+
+def to_pcm16k(path: str) -> np.ndarray:
+    """Any audio file → mono float32 @16 kHz."""
+    if path.lower().endswith(".wav"):
+        from localai_tpu.audio.pcm import read_wav
+
+        audio, _ = read_wav(path, target_rate=16000)
+        return audio
+    if not ffmpeg_available():
+        raise RuntimeError(
+            f"cannot decode {os.path.basename(path)!r}: non-WAV input needs "
+            f"an ffmpeg binary on PATH (reference pkg/utils/ffmpeg.go role)")
+    with tempfile.NamedTemporaryFile(suffix=".wav", delete=False) as tmp:
+        out = tmp.name
+    try:
+        subprocess.run(
+            ["ffmpeg", "-y", "-i", path, "-ar", "16000", "-ac", "1",
+             "-f", "wav", out],
+            check=True, capture_output=True, timeout=600)
+        from localai_tpu.audio.pcm import read_wav
+
+        audio, _ = read_wav(out)
+        return audio
+    except subprocess.CalledProcessError as e:
+        raise RuntimeError(
+            f"ffmpeg failed: {e.stderr.decode(errors='replace')[-400:]}")
+    finally:
+        if os.path.exists(out):
+            os.unlink(out)

@@ -97,6 +97,29 @@ class LLMServicer(BackendServicer):
                 mesh = build_mesh(MeshConfig(data=1, model=model),
                                   devices[:model])
 
+        from localai_tpu.ops.kvcache import is_quant_kind
+        from localai_tpu.system.memory import estimate
+
+        # normalize exactly like the engine does below: quant in EITHER
+        # field means int8 KV
+        kv_kind = "int8" if (is_quant_kind(request.cache_type_key)
+                             or is_quant_kind(request.cache_type_value)) \
+            else ""
+        est = estimate(cfg, slots=request.parallel or 4,
+                       context=request.context_size or min(
+                           2048, cfg.max_position),
+                       dtype=request.dtype or cfg.dtype,
+                       cache_type=kv_kind)
+        if est.fits is False:
+            import logging
+
+            logging.getLogger("localai_tpu").warning(
+                "model may not fit HBM: need ~%.1f GiB of %.1f GiB "
+                "(weights %.1f + kv %.1f + working %.1f)",
+                est.total_bytes / 2**30, (est.hbm_bytes or 0) / 2**30,
+                est.weights_bytes / 2**30, est.kv_cache_bytes / 2**30,
+                est.working_bytes / 2**30)
+
         params = load_params(model_dir, cfg, dtype=request.dtype or None,
                              mesh=mesh)
         tok = load_tokenizer(model_dir)
@@ -116,14 +139,9 @@ class LLMServicer(BackendServicer):
             dcfg = load_config(draft_dir, dtype=request.dtype or None)
             draft = (dcfg, load_params(draft_dir, dcfg,
                                        dtype=request.dtype or None))
-        from localai_tpu.ops.kvcache import is_quant_kind
-
         # one storage kind for both K and V (quantize when either side asks;
         # the reference allows split k/v types — grpc-server.cpp:236-251)
-        cache_type = ""
-        if (is_quant_kind(request.cache_type_key)
-                or is_quant_kind(request.cache_type_value)):
-            cache_type = "int8"
+        cache_type = kv_kind
         self.engine = Engine(cfg, params, tok, EngineConfig(
             max_slots=request.parallel or 4,
             max_context=context_size,

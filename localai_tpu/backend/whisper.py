@@ -37,11 +37,13 @@ class WhisperServicer(BackendServicer):
     def AudioTranscription(self, request, context):
         if self.model is None:
             context.abort(grpc.StatusCode.FAILED_PRECONDITION, "no model")
-        from localai_tpu.audio.pcm import read_wav
+        from localai_tpu.audio.transcode import to_pcm16k
         from localai_tpu.audio.vad import detect_segments_auto
 
         try:
-            audio, _ = read_wav(request.dst, target_rate=16000)
+            # WAV natively; other containers via the ffmpeg shell-out role
+            # (reference pkg/utils/ffmpeg.go)
+            audio = to_pcm16k(request.dst)
         except Exception as e:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT,
                           f"cannot read audio: {e}")

@@ -26,6 +26,7 @@ from localai_tpu.models.llama import LlamaConfig, param_specs
 LLAMA_FAMILY = {
     "LlamaForCausalLM": {},
     "MistralForCausalLM": {},
+    "MixtralForCausalLM": {"moe": True},
     "Qwen2ForCausalLM": {"qkv_bias": True},
     "TinyLlamaForCausalLM": {},
 }
@@ -60,6 +61,9 @@ def load_config(model_dir: str, dtype: str | None = None) -> LlamaConfig:
         sliding_window=hf.get("sliding_window"),
         qkv_bias=hf.get("attention_bias", extra.get("qkv_bias", False)),
     )
+    if extra.get("moe") or hf.get("num_local_experts"):
+        kw["num_experts"] = hf.get("num_local_experts", 8)
+        kw["experts_per_tok"] = hf.get("num_experts_per_tok", 2)
     if dtype is not None:
         # int8 = weight quantization; activations/KV stay bf16
         kw["dtype"] = ("bfloat16" if dtype in ("int8", "q8", "int4", "q4")
@@ -227,10 +231,30 @@ def load_params(
         "wv": stack(L + "self_attn.v_proj.weight", True),
         "wo": stack(L + "self_attn.o_proj.weight", True),
         "mlp_norm": stack(L + "post_attention_layernorm.weight", False),
-        "w_gate": stack(L + "mlp.gate_proj.weight", True),
-        "w_up": stack(L + "mlp.up_proj.weight", True),
-        "w_down": stack(L + "mlp.down_proj.weight", True),
     }
+    if cfg.num_experts:
+        # Mixtral MoE: experts stacked [L, E, in, out]
+        # (block_sparse_moe.gate + experts.N.w{1,2,3})
+        def stack_experts(which: str):
+            out = []
+            for i in range(cfg.num_layers):
+                row = [r.get(f"model.layers.{i}.block_sparse_moe."
+                             f"experts.{e}.{which}.weight").T
+                       for e in range(cfg.num_experts)]
+                out.append(np.stack(row))
+            return np.stack(out)
+
+        layers["moe_gate"] = stack(
+            L + "block_sparse_moe.gate.weight", True)
+        layers["moe_w1"] = stack_experts("w1")
+        layers["moe_w2"] = stack_experts("w2")
+        layers["moe_w3"] = stack_experts("w3")
+    else:
+        layers.update({
+            "w_gate": stack(L + "mlp.gate_proj.weight", True),
+            "w_up": stack(L + "mlp.up_proj.weight", True),
+            "w_down": stack(L + "mlp.down_proj.weight", True),
+        })
     if cfg.qkv_bias:
         layers["bq"] = stack(L + "self_attn.q_proj.bias", False)
         layers["bk"] = stack(L + "self_attn.k_proj.bias", False)
@@ -291,7 +315,7 @@ def _synthetic_params(cfg: LlamaConfig, *, dtype, mesh=None, qbits=None):
                      (fan_in ** -0.5) * (1.73 / qmax), jnp.float32)
         return {"q": q, "s": s}
 
-    ks = jax.random.split(key, 10)
+    ks = jax.random.split(key, 12)
     layers = {
         "attn_norm": jnp.ones((L, h), dtype),
         "wq": qrand(ks[0], (L, h, nh * hd), h),
@@ -299,10 +323,20 @@ def _synthetic_params(cfg: LlamaConfig, *, dtype, mesh=None, qbits=None):
         "wv": qrand(ks[2], (L, h, nkv * hd), h),
         "wo": qrand(ks[3], (L, nh * hd, h), nh * hd),
         "mlp_norm": jnp.ones((L, h), dtype),
-        "w_gate": qrand(ks[4], (L, h, inter), h),
-        "w_up": qrand(ks[5], (L, h, inter), h),
-        "w_down": qrand(ks[6], (L, inter, h), inter),
     }
+    if cfg.num_experts:
+        E = cfg.num_experts
+        layers["moe_gate"] = (
+            jax.random.normal(ks[9], (L, h, E), jnp.float32) * (h ** -0.5))
+        layers["moe_w1"] = qrand(ks[4], (L, E, h, inter), h)
+        layers["moe_w2"] = qrand(ks[5], (L, E, inter, h), inter)
+        layers["moe_w3"] = qrand(ks[6], (L, E, h, inter), h)
+    else:
+        layers.update({
+            "w_gate": qrand(ks[4], (L, h, inter), h),
+            "w_up": qrand(ks[5], (L, h, inter), h),
+            "w_down": qrand(ks[6], (L, inter, h), inter),
+        })
     if cfg.qkv_bias:
         layers["bq"] = jnp.zeros((L, nh * hd), dtype)
         layers["bk"] = jnp.zeros((L, nkv * hd), dtype)

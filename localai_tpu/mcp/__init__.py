@@ -22,7 +22,12 @@ class MCPError(RuntimeError):
 
 
 class _StdioTransport:
-    """Newline-delimited JSON-RPC over a spawned server process."""
+    """Newline-delimited JSON-RPC over a spawned server process.
+
+    Reads are done at the fd level (os.read after select) with our own line
+    buffer: select() on a buffered TextIO misses lines already pulled into
+    the userspace buffer, which would stall a reply that arrived in the same
+    chunk as a server notification."""
 
     def __init__(self, command: str, env: dict | None = None):
         import os
@@ -33,34 +38,46 @@ class _StdioTransport:
         self.proc = subprocess.Popen(
             shlex.split(command), stdin=subprocess.PIPE,
             stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
-            text=True, env=full_env)
+            env=full_env)
         self._lock = threading.Lock()
+        self._buf = bytearray()
+
+    def _readline(self, deadline: float) -> bytes:
+        import os
+        import select
+        import time
+
+        while b"\n" not in self._buf:
+            remain = deadline - time.monotonic()
+            if remain <= 0:
+                raise MCPError("MCP server timed out")
+            ready, _, _ = select.select([self.proc.stdout], [], [],
+                                        min(remain, 1.0))
+            if not ready:
+                if self.proc.poll() is not None:
+                    raise MCPError("MCP server process exited")
+                continue
+            chunk = os.read(self.proc.stdout.fileno(), 1 << 16)
+            if not chunk:
+                raise MCPError("MCP server closed the pipe")
+            self._buf.extend(chunk)
+        line, _, rest = bytes(self._buf).partition(b"\n")
+        self._buf = bytearray(rest)
+        return line
 
     def request(self, payload: dict, timeout: float = 30.0) -> dict | None:
-        import select
+        import time
 
         with self._lock:
             if self.proc.poll() is not None:
                 raise MCPError("MCP server process exited")
-            self.proc.stdin.write(json.dumps(payload) + "\n")
+            self.proc.stdin.write((json.dumps(payload) + "\n").encode())
             self.proc.stdin.flush()
             if "id" not in payload:      # notification: no response expected
                 return None
-            deadline = __import__("time").monotonic() + timeout
+            deadline = time.monotonic() + timeout
             while True:
-                remain = deadline - __import__("time").monotonic()
-                if remain <= 0:
-                    raise MCPError(
-                        f"MCP server timed out after {timeout:.0f}s")
-                ready, _, _ = select.select([self.proc.stdout], [], [],
-                                            min(remain, 1.0))
-                if not ready:
-                    if self.proc.poll() is not None:
-                        raise MCPError("MCP server process exited")
-                    continue
-                line = self.proc.stdout.readline()
-                if not line:
-                    raise MCPError("MCP server closed the pipe")
+                line = self._readline(deadline)
                 try:
                     msg = json.loads(line)
                 except ValueError:

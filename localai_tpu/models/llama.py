@@ -59,6 +59,8 @@ class LlamaConfig:
     qkv_bias: bool = False              # Qwen2
     tie_embeddings: bool = False
     sliding_window: int | None = None   # Mistral
+    num_experts: int = 0                # Mixtral MoE (0 = dense MLP)
+    experts_per_tok: int = 2
     dtype: str = "bfloat16"
 
     @property
@@ -100,10 +102,19 @@ def init_params(cfg: LlamaConfig, key, dtype=None):
         "wv": norm(ks[2], (L, h, nkv * hd), h),
         "wo": norm(ks[3], (L, nh * hd, h), nh * hd),
         "mlp_norm": jnp.ones((L, h), dtype),
-        "w_gate": norm(ks[4], (L, h, I), h),
-        "w_up": norm(ks[5], (L, h, I), h),
-        "w_down": norm(ks[6], (L, I, h), I),
     }
+    if cfg.num_experts:
+        E = cfg.num_experts
+        layers["moe_gate"] = norm(ks[4], (L, h, E), h).astype(jnp.float32)
+        layers["moe_w1"] = norm(ks[5], (L, E, h, I), h)
+        layers["moe_w2"] = norm(ks[6], (L, E, I, h), I)
+        layers["moe_w3"] = norm(ks[9], (L, E, h, I), h)
+    else:
+        layers.update({
+            "w_gate": norm(ks[4], (L, h, I), h),
+            "w_up": norm(ks[5], (L, h, I), h),
+            "w_down": norm(ks[6], (L, I, h), I),
+        })
     if cfg.qkv_bias:
         layers["bq"] = jnp.zeros((L, nh * hd), dtype)
         layers["bk"] = jnp.zeros((L, nkv * hd), dtype)
@@ -131,10 +142,20 @@ def param_specs(cfg: LlamaConfig):
         "wv": P(None, None, "model"),
         "wo": P(None, "model", None),
         "mlp_norm": P(None, None),
-        "w_gate": P(None, None, "model"),
-        "w_up": P(None, None, "model"),
-        "w_down": P(None, "model", None),
     }
+    if cfg.num_experts:
+        # expert parallelism: experts sharded over the `model` axis (the
+        # GSPMD answer to EP — XLA reduces the masked combine across shards)
+        layers["moe_gate"] = P(None, None, None)
+        layers["moe_w1"] = P(None, "model", None, None)
+        layers["moe_w2"] = P(None, "model", None, None)
+        layers["moe_w3"] = P(None, "model", None, None)
+    else:
+        layers.update({
+            "w_gate": P(None, None, "model"),
+            "w_up": P(None, None, "model"),
+            "w_down": P(None, "model", None),
+        })
     if cfg.qkv_bias:
         layers["bq"] = P(None, "model")
         layers["bk"] = P(None, "model")
@@ -158,6 +179,8 @@ def max_model_axis(cfg: LlamaConfig, n_devices: int) -> int:
         cfg.intermediate_size,
         cfg.num_kv_heads,  # kv cache shards the head axis
     ]
+    if cfg.num_experts:
+        dims.append(cfg.num_experts)  # expert parallelism
     if not cfg.tie_embeddings:
         dims.append(cfg.vocab_size)  # vocab-parallel lm_head
     for d in range(n_devices, 0, -1):
@@ -234,9 +257,41 @@ def _lm_head(x32, params):
     return qmatmul(x32, head)
 
 
-def _mlp(x, lp):
+def _mlp(x, lp, cfg=None):
+    if "moe_gate" in lp:
+        return _moe_mlp(x, lp, cfg.experts_per_tok if cfg else 2)
     return qmatmul(jax.nn.silu(qmatmul(x, lp["w_gate"])) * qmatmul(x, lp["w_up"]),
                    lp["w_down"])
+
+
+def _moe_mlp(x, lp, k: int):
+    """Mixtral top-k routed experts (reference: the MoE GGUFs llama.cpp
+    serves within ggml — SURVEY §2.4 expert-parallel row; HF semantics:
+    softmax router → top-k → renormalize → weighted expert sum).
+
+    Dense dispatch: every expert runs on every token and the top-k mask
+    zeroes the rest — einsum-shaped for the MXU and for GSPMD expert
+    parallelism (experts sharded on the `model` mesh axis; XLA turns the
+    masked combine into an all-reduce). Top-k gather/scatter dispatch is a
+    later optimization for large-E prefill."""
+    from localai_tpu.ops.quant import dequantize, is_quantized
+
+    def dq(p):
+        return dequantize(p, x.dtype) if is_quantized(p) else p
+
+    gate = lp["moe_gate"].astype(jnp.float32)
+    logits = x.astype(jnp.float32) @ gate                      # [B, S, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_w, top_i = jax.lax.top_k(probs, k)
+    top_w = top_w / jnp.maximum(top_w.sum(-1, keepdims=True), 1e-9)
+    E = gate.shape[-1]
+    combine = jnp.einsum("bske,bsk->bse",
+                         jax.nn.one_hot(top_i, E, dtype=jnp.float32), top_w)
+    w1, w2, w3 = dq(lp["moe_w1"]), dq(lp["moe_w2"]), dq(lp["moe_w3"])
+    h1 = jnp.einsum("bsh,ehi->bsei", x, w1)
+    h3 = jnp.einsum("bsh,ehi->bsei", x, w3)
+    y = jnp.einsum("bsei,eih->bseh", jax.nn.silu(h1) * h3, w2)
+    return jnp.einsum("bseh,bse->bsh", y, combine.astype(x.dtype))
 
 
 # Activation sharding hints: hard constraints when a mesh is active (raises on
@@ -341,7 +396,7 @@ def prefill(params, cfg: LlamaConfig, tokens, lengths, cos, sin,
         attn = attn_prefill(q, k, v, lengths, sliding_window=cfg.sliding_window)
         x = x + qmatmul(attn.reshape(b, s, -1), lp["wo"])
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
-        x = x + _mlp(h, lp)
+        x = x + _mlp(h, lp, cfg)
         x = _shard_act(x, P("data", _seq_ax(), None))
         kc, vc = _cache_write(kc, vc, k, v, slot_map, positions)
         return x, (kc, vc)
@@ -389,7 +444,7 @@ def decode_step(params, cfg: LlamaConfig, tokens, lengths, cos, sin,
                            sliding_window=cfg.sliding_window)
         x = x + qmatmul(attn.reshape(b, 1, -1), lp["wo"])
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
-        x = x + _mlp(h, lp)
+        x = x + _mlp(h, lp, cfg)
         return x, (kc, vc)
 
     x, (k_cache, v_cache) = jax.lax.scan(
@@ -421,7 +476,7 @@ def hidden_states(params, cfg: LlamaConfig, tokens, lengths=None):
         attn = attn_prefill(q, k, v, lengths, sliding_window=cfg.sliding_window)
         x = x + qmatmul(attn.reshape(b, s, -1), lp["wo"])
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
-        x = x + _mlp(h, lp)
+        x = x + _mlp(h, lp, cfg)
         x = _shard_act(x, P("data", _seq_ax(), None))
         return x, None
 
@@ -464,7 +519,7 @@ def extend(params, cfg: LlamaConfig, tokens, start, cos, sin,
                           sliding_window=cfg.sliding_window)
         x = x + qmatmul(attn.reshape(b, s, -1), lp["wo"])
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
-        x = x + _mlp(h, lp)
+        x = x + _mlp(h, lp, cfg)
         return x, (kc, vc)
 
     x, (k_cache, v_cache) = jax.lax.scan(

@@ -401,8 +401,12 @@ class WhisperModel:
                 cross_k=ckb, cross_v=cvb, kc=kc, vc=vc)
             lp = self._logprobs_host(logits)            # [B, V]
             if i + 1 in forced:
+                # forced tokens may themselves be suppressed (whisper's
+                # standard lists overlap) — a -inf here would collapse every
+                # beam; count them as free, like _sample_decode does
                 tok = forced[i + 1]
-                cum = cum + lp[:, tok]
+                step = lp[:, tok]
+                cum = cum + np.where(np.isfinite(step), step, 0.0)
                 for s in seqs:
                     s.append(tok)
                 continue

@@ -59,7 +59,8 @@ def quantize_params(params, *, bits: int = 8, skip=("embed", "final_norm")):
     for k, v in params.items():
         if k == "layers":
             out[k] = {
-                lk: (quantize(lv, bits) if lk.startswith("w") else lv)
+                lk: (quantize(lv, bits)
+                     if lk.startswith("w") or lk.startswith("moe_w") else lv)
                 for lk, lv in v.items()
             }
         elif k == "lm_head":

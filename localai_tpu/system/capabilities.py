@@ -43,6 +43,14 @@ def system_info() -> dict:
     """CPU/memory/accelerator summary (xsysinfo role)."""
     info: dict = {"capability": detect_capability()}
     try:
+        from localai_tpu.system.memory import hbm_table_bytes
+
+        hbm = hbm_table_bytes(info["capability"])
+        if hbm:
+            info["hbm_bytes"] = hbm
+    except Exception:
+        pass
+    try:
         info["cpu_count"] = os.cpu_count()
         with open("/proc/meminfo") as f:
             for line in f:

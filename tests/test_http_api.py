@@ -208,15 +208,18 @@ def test_tts_and_vad_http(stack):
         assert w.getframerate() == 16000
         assert w.getnframes() > 1000
 
+    from localai_tpu.audio.tts import synthesize
+
     rate = 16000
-    tone = (0.5 * np.sin(2 * np.pi * 300 * np.arange(rate) / rate))
+    speech = synthesize("good morning everyone", voice="default",
+                        language="en").astype(np.float32)[: rate]
     silence = 0.001 * np.random.default_rng(0).normal(size=rate)
-    audio = np.concatenate([silence, tone, silence]).astype(np.float32)
+    audio = np.concatenate([silence, speech, silence]).astype(np.float32)
     r = requests.post(base + "/vad", json={"audio": audio.tolist()},
                       timeout=120)
     assert r.status_code == 200
     segs = r.json()["segments"]
-    assert len(segs) == 1 and 0.8 < segs[0]["start"] < 1.3
+    assert len(segs) >= 1 and 0.6 < segs[0]["start"] < 1.4
 
 
 def test_webui_served(stack):
