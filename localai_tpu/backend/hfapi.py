@@ -1,0 +1,111 @@
+"""Hugging Face Inference-API passthrough backend — no local compute.
+
+Reference: /root/reference/backend/go/huggingface/langchain.go — LoadModel
+takes the HF model id + HUGGINGFACEHUB_API_TOKEN, Predict posts the prompt to
+the hosted Inference API. PredictStream replays the full completion as one
+chunk (the reference does the same; the hosted API is not streamed).
+
+The endpoint base is overridable via ModelOptions.options JSON
+({"endpoint": ...}) — used by tests (zero-egress image) and for
+Inference-Endpoints deployments.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import urllib.request
+
+import grpc
+
+from localai_tpu.backend import pb
+from localai_tpu.backend.base import BackendServicer
+
+DEFAULT_ENDPOINT = "https://api-inference.huggingface.co/models"
+
+
+class HFApiServicer(BackendServicer):
+    def __init__(self):
+        self.model = ""
+        self.token = ""
+        self.endpoint = DEFAULT_ENDPOINT
+        self._state = pb.StatusResponse.UNINITIALIZED
+        self._lock = threading.Lock()
+
+    def LoadModel(self, request, context):
+        with self._lock:
+            opts = {}
+            if request.options:
+                try:
+                    opts = json.loads(request.options)
+                except ValueError:
+                    pass
+            token = (opts.get("token")
+                     or os.environ.get("HUGGINGFACEHUB_API_TOKEN", ""))
+            if not token:
+                self._state = pb.StatusResponse.ERROR
+                return pb.Result(
+                    success=False,
+                    message="no huggingface token provided "
+                            "(HUGGINGFACEHUB_API_TOKEN)")
+            self.model = request.model
+            self.token = token
+            self.endpoint = opts.get("endpoint", DEFAULT_ENDPOINT).rstrip("/")
+            self._state = pb.StatusResponse.READY
+            return pb.Result(success=True, message="ok")
+
+    def _predict_text(self, request) -> str:
+        params: dict = {"return_full_text": False}
+        if request.tokens:
+            params["max_new_tokens"] = request.tokens
+        if request.temperature:
+            params["temperature"] = request.temperature
+        if request.top_k:
+            params["top_k"] = request.top_k
+        if request.top_p:
+            params["top_p"] = request.top_p
+        body = json.dumps({"inputs": request.prompt,
+                           "parameters": params}).encode()
+        req = urllib.request.Request(
+            f"{self.endpoint}/{self.model}", data=body,
+            headers={"Authorization": f"Bearer {self.token}",
+                     "Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=600) as r:
+            out = json.load(r)
+        if isinstance(out, list) and out and "generated_text" in out[0]:
+            text = out[0]["generated_text"]
+        elif isinstance(out, dict) and "generated_text" in out:
+            text = out["generated_text"]
+        else:
+            raise ValueError(f"unexpected Inference API reply: {out!r}")
+        for stop in request.stop_prompts:
+            i = text.find(stop)
+            if i != -1:
+                text = text[:i]
+        return text
+
+    def _require_loaded(self, context):
+        if not self.model:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                          "no model loaded (call LoadModel first)")
+
+    def Predict(self, request, context):
+        self._require_loaded(context)
+        try:
+            text = self._predict_text(request)
+        except Exception as e:
+            context.abort(grpc.StatusCode.UNAVAILABLE,
+                          f"{type(e).__name__}: {e}")
+        return pb.Reply(message=text.encode(), finish_reason="stop")
+
+    def PredictStream(self, request, context):
+        self._require_loaded(context)
+        try:
+            text = self._predict_text(request)
+        except Exception as e:
+            context.abort(grpc.StatusCode.UNAVAILABLE,
+                          f"{type(e).__name__}: {e}")
+        yield pb.Reply(message=text.encode(), finish_reason="stop")
+
+    def Status(self, request, context):
+        return pb.StatusResponse(state=self._state)

@@ -98,32 +98,44 @@ class LLMServicer(BackendServicer):
                                   devices[:model])
 
         from localai_tpu.ops.kvcache import is_quant_kind
-        from localai_tpu.system.memory import estimate
 
         # normalize exactly like the engine does below: quant in EITHER
         # field means int8 KV
         kv_kind = "int8" if (is_quant_kind(request.cache_type_key)
                              or is_quant_kind(request.cache_type_value)) \
             else ""
+        context_size = request.context_size or min(2048, cfg.max_position)
+
+        draft_dir = dcfg = None
+        if request.draft_model:
+            draft_dir = request.draft_model
+            if request.model_path and not os.path.isdir(draft_dir):
+                draft_dir = os.path.join(request.model_path, draft_dir)
+            dcfg = load_config(draft_dir, dtype=request.dtype or None)
+
+        from localai_tpu.system.memory import estimate
+
+        # the estimate is per chip: a TP mesh shards weights + KV over the
+        # model axis (a replica-per-data-shard would not divide weights, but
+        # the auto mesh here is data=1)
+        shards = 1 if mesh is None else int(mesh.devices.size)
         est = estimate(cfg, slots=request.parallel or 4,
-                       context=request.context_size or min(
-                           2048, cfg.max_position),
+                       context=context_size,
                        dtype=request.dtype or cfg.dtype,
-                       cache_type=kv_kind)
+                       cache_type=kv_kind, draft_cfg=dcfg, shards=shards)
         if est.fits is False:
             import logging
 
             logging.getLogger("localai_tpu").warning(
-                "model may not fit HBM: need ~%.1f GiB of %.1f GiB "
-                "(weights %.1f + kv %.1f + working %.1f)",
+                "model may not fit HBM: need ~%.1f GiB of %.1f GiB per chip "
+                "(weights %.1f + kv %.1f + working %.1f, %d chip(s))",
                 est.total_bytes / 2**30, (est.hbm_bytes or 0) / 2**30,
                 est.weights_bytes / 2**30, est.kv_cache_bytes / 2**30,
-                est.working_bytes / 2**30)
+                est.working_bytes / 2**30, shards)
 
         params = load_params(model_dir, cfg, dtype=request.dtype or None,
                              mesh=mesh)
         tok = load_tokenizer(model_dir)
-        context_size = request.context_size or min(2048, cfg.max_position)
         # single-shot prefill up to the chunk size; longer prompts prefill in
         # chunk-sized pieces interleaved with running decodes
         chunk = min(512, context_size)
@@ -131,12 +143,8 @@ class LLMServicer(BackendServicer):
             b for b in (64, 256, 512) if b <= chunk
         ) or (chunk,)
         draft = None
-        if request.draft_model:
+        if dcfg is not None:
             # speculative decoding (reference DraftModel, backend.proto:218)
-            draft_dir = request.draft_model
-            if request.model_path and not os.path.isdir(draft_dir):
-                draft_dir = os.path.join(request.model_path, draft_dir)
-            dcfg = load_config(draft_dir, dtype=request.dtype or None)
             draft = (dcfg, load_params(draft_dir, dcfg,
                                        dtype=request.dtype or None))
         # one storage kind for both K and V (quantize when either side asks;

@@ -57,6 +57,13 @@ def _make_tts():
     return TTSServicer()
 
 
+@_role("huggingface")
+def _make_hfapi():
+    from localai_tpu.backend.hfapi import HFApiServicer
+
+    return HFApiServicer()
+
+
 @_role("detect")
 def _make_detect():
     from localai_tpu.backend.detect import DetectServicer

@@ -169,6 +169,16 @@ def _add_worker(sub):
     return p
 
 
+def _add_launcher(sub):
+    p = sub.add_parser("launcher",
+                       help="interactive server controller "
+                            "(reference: cmd/launcher GUI role)")
+    p.add_argument("--address", default="127.0.0.1:8080")
+    p.add_argument("--models-path", default="models")
+    p.add_argument("--autostart", action="store_true")
+    return p
+
+
 def _add_explorer(sub):
     p = sub.add_parser("explorer",
                        help="federation dashboard + network discovery "
@@ -250,6 +260,7 @@ def main(argv=None):
     _add_models(sub)
     _add_backends(sub)
     _add_explorer(sub)
+    _add_launcher(sub)
     _add_federated(sub)
     _add_worker(sub)
     _add_tts(sub)
@@ -281,6 +292,10 @@ def main(argv=None):
         from localai_tpu.explorer import run_explorer
 
         return run_explorer(args)
+    if cmd == "launcher":
+        from localai_tpu.launcher import run_launcher
+
+        return run_launcher(args)
     if cmd == "federated":
         from localai_tpu.federation import run_federated
 

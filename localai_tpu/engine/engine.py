@@ -77,6 +77,10 @@ class EngineConfig:
                                    # (parallel/distributed.Replicator)
     gamma: int = 4                # speculative: draft tokens per step
                                   # (reference NDraft, backend.proto:150)
+    prompt_cache: bool = True     # reuse a freed slot's KV prefix when a new
+                                  # prompt shares it (llama.cpp prompt/slot
+                                  # cache role, backend.proto:136-142)
+    prompt_cache_min: int = 16    # minimum shared prefix worth reusing
 
 
 @dataclasses.dataclass
@@ -187,6 +191,9 @@ class Engine:
         # host-side slot table
         self._slots: list[_Slot | None] = [None] * B
         self._free: list[int] = list(range(B))
+        # prompt cache: per slot, the token ids whose K/V rows are still
+        # valid in that slot's cache region (recorded at release)
+        self._slot_kv_tokens: list[list[int]] = [[] for _ in range(B)]
         # chunked prefill: chunk window + the buckets small enough to prefill
         # single-shot without stalling running decodes longer than one chunk
         if self.ec.prefill_chunk < 8:
@@ -221,6 +228,8 @@ class Engine:
             "requests_completed": 0,
             "tokens_generated": 0,
             "prompt_tokens_processed": 0,
+            "prompt_tokens_reused": 0,
+            "prompt_cache_hits": 0,
             "ttft_ms_last": 0.0,
             "tokens_per_second_last": 0.0,
         }
@@ -587,7 +596,14 @@ class Engine:
                 prompt_tokens=len(req.prompt_ids),
             ))
             return False
-        slot = self._free.pop()
+        slot, lcp = self._pick_slot(req.prompt_ids)
+        self._slot_kv_tokens[slot] = []
+        if lcp:
+            # shared prefix already in this slot's cache: prefill only the
+            # suffix via the chunked-extend path (start offset = lcp)
+            chunked = True
+            self.metrics["prompt_cache_hits"] += 1
+            self.metrics["prompt_tokens_reused"] += lcp
         counts_row = np.zeros((self.cfg.vocab_size,), np.int32)
         pid, pcnt = np.unique(np.asarray(req.prompt_ids, np.int64), return_counts=True)
         counts_row[pid] = pcnt
@@ -606,6 +622,7 @@ class Engine:
             matcher=matcher,
             start_time=time.monotonic(), prompt_len=n,
             prefilled=not chunked, row=row, counts_row=counts_row,
+            prefill_pos=lcp,
         )
         self._slots[slot] = slot_obj
         if chunked:
@@ -614,7 +631,7 @@ class Engine:
             eos = self.tok.eos_ids if self.tok else ()
             self._mask_host[slot] = matcher.mask_bits(eos)
             self._grammar_slots += 1
-        self.metrics["prompt_tokens_processed"] += n
+        self.metrics["prompt_tokens_processed"] += n - lcp
         if not chunked and self._draft is not None:
             # spec invariant: the first token is sampled (and emitted) at
             # admission; it becomes the carried next_token
@@ -859,10 +876,50 @@ class Engine:
             self.metrics["requests_completed"] += 1
             self._release_slot(idx, slot)
 
+    def _pick_slot(self, prompt_ids: list[int]) -> tuple[int, int]:
+        """Choose a free slot, preferring one whose cached tokens share the
+        longest prefix with the new prompt (llama.cpp's slot prompt cache).
+        Returns (slot, reusable_prefix_len); 0 = cold prefill."""
+        limit = self.ec.max_context - 2 - self._ctx_reserve
+
+        def common(cached: list[int]) -> int:
+            m = min(len(cached), len(prompt_ids) - 1, limit - 1)
+            i = 0
+            while i < m and cached[i] == prompt_ids[i]:
+                i += 1
+            return i
+
+        best_slot, best_lcp = None, 0
+        if self.ec.prompt_cache and self._draft is None:
+            for s in self._free:
+                lcp = common(self._slot_kv_tokens[s])
+                if lcp > best_lcp:
+                    best_slot, best_lcp = s, lcp
+        if best_slot is not None and best_lcp >= self.ec.prompt_cache_min:
+            self._free.remove(best_slot)
+            return best_slot, best_lcp
+        # cold admission: take the free slot with the LEAST useful cached
+        # record, so other tenants' warm prefixes survive (llama.cpp picks
+        # the slot without a usable cache the same way)
+        cold = min(self._free,
+                   key=lambda s: len(self._slot_kv_tokens[s]))
+        self._free.remove(cold)
+        return cold, 0
+
     def _release_slot(self, idx: int, slot: _Slot):
         if slot.matcher is not None:
             self._mask_host[idx] = 0xFF
             self._grammar_slots -= 1
+        # record what this slot's cache still holds (valid rows 0..len-1) so
+        # a future prompt sharing the prefix skips that part of its prefill.
+        # Shifted slots moved rows — their mapping is no longer positional.
+        if (self.ec.prompt_cache and self._draft is None
+                and slot.shifted == 0):
+            kept = (list(slot.req.prompt_ids) + slot.gen_ids)[
+                : self.ec.max_context - 2]
+            self._slot_kv_tokens[idx] = kept
+        else:
+            self._slot_kv_tokens[idx] = []
         self._slots[idx] = None
         self._free.append(idx)
 

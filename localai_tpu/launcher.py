@@ -1,0 +1,136 @@
+"""Terminal launcher — the GUI-launcher role.
+
+Reference: /root/reference/cmd/launcher (a Fyne systray app wrapping the
+server: start/stop, log tail, open the WebUI). A TPU pod has no desktop, so
+the launcher here is a small interactive terminal controller around the same
+operations: spawn/stop `localai-tpu run`, watch health, tail the server log,
+and print the WebUI address.
+
+Programmatic surface (`Launcher`) is separated from the REPL so the control
+operations are testable headless.
+"""
+from __future__ import annotations
+
+import collections
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+
+class Launcher:
+    def __init__(self, address: str = "127.0.0.1:8080",
+                 models_path: str = "models", extra_args: list[str] | None
+                 = None, log_lines: int = 400):
+        self.address = address
+        self.models_path = models_path
+        self.extra_args = extra_args or []
+        self.proc: subprocess.Popen | None = None
+        self.log: collections.deque[str] = collections.deque(
+            maxlen=log_lines)
+        self._tail_thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------ control
+
+    def start(self) -> bool:
+        if self.running:
+            return True
+        argv = [sys.executable, "-m", "localai_tpu.cli", "run",
+                "--address", self.address,
+                "--models-path", self.models_path] + self.extra_args
+        env = dict(os.environ)
+        pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        parts = [pkg_root] + [p for p in env.get("PYTHONPATH", "").split(
+            os.pathsep) if p]
+        env["PYTHONPATH"] = os.pathsep.join(dict.fromkeys(parts))
+        self.proc = subprocess.Popen(
+            argv, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        self._tail_thread = threading.Thread(target=self._tail, daemon=True)
+        self._tail_thread.start()
+        return True
+
+    def _tail(self):
+        proc = self.proc
+        for line in proc.stdout or []:
+            self.log.append(line.rstrip())
+
+    def stop(self, timeout: float = 10.0):
+        if self.proc is None:
+            return
+        self.proc.terminate()
+        try:
+            self.proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+        self.proc = None
+
+    @property
+    def running(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def healthy(self, timeout: float = 2.0) -> bool:
+        try:
+            with urllib.request.urlopen(
+                    f"http://{self.address}/healthz", timeout=timeout) as r:
+                return r.status == 200
+        except Exception:
+            return False
+
+    def wait_healthy(self, attempts: int = 60, sleep: float = 0.5) -> bool:
+        for _ in range(attempts):
+            if self.healthy():
+                return True
+            if not self.running:
+                return False
+            time.sleep(sleep)
+        return False
+
+    def tail(self, n: int = 20) -> list[str]:
+        return list(self.log)[-n:]
+
+    @property
+    def webui_url(self) -> str:
+        return f"http://{self.address}/"
+
+
+def run_launcher(args) -> int:
+    """CLI `launcher`: interactive controller (reference cmd/launcher role)."""
+    l = Launcher(address=args.address, models_path=args.models_path)
+    print("localai-tpu launcher — commands: "
+          "[s]tart [x]stop [l]ogs [h]ealth [w]ebui [q]uit", flush=True)
+    if args.autostart:
+        print("starting server...", flush=True)
+        l.start()
+        print("healthy" if l.wait_healthy() else "NOT healthy", flush=True)
+    try:
+        while True:
+            try:
+                cmd = input("> ").strip().lower()
+            except EOFError:
+                break
+            if cmd in ("q", "quit", "exit"):
+                break
+            elif cmd in ("s", "start"):
+                l.start()
+                print("healthy" if l.wait_healthy() else "NOT healthy",
+                      flush=True)
+            elif cmd in ("x", "stop"):
+                l.stop()
+                print("stopped", flush=True)
+            elif cmd in ("l", "logs"):
+                for line in l.tail(20):
+                    print(line, flush=True)
+            elif cmd in ("h", "health"):
+                print("running" if l.running else "not running",
+                      "| healthy" if l.healthy() else "| unhealthy",
+                      flush=True)
+            elif cmd in ("w", "webui"):
+                print(l.webui_url, flush=True)
+            elif cmd:
+                print("unknown command", flush=True)
+    finally:
+        l.stop()
+    return 0

@@ -684,10 +684,18 @@ class API:
             if h is None:
                 continue
             st = await asyncio.to_thread(lambda hh=h: hh.client.status())
+            try:
+                metrics = await asyncio.to_thread(
+                    lambda hh=h: hh.client.metrics())
+            except Exception:
+                metrics = {}
             out[name] = {
                 "state": int(st.state),
                 "memory_total": st.memory.total,
                 "busy": h.busy,
+                # per-backend engine metrics (reference GetMetrics +
+                # get_token_metrics.go role): tok/s, ttft, cache hits...
+                "metrics": metrics,
             }
         return web.json_response(out)
 

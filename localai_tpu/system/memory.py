@@ -88,9 +88,11 @@ def detect_hbm_bytes() -> int | None:
 
 def estimate(cfg, *, slots: int, context: int, dtype: str = "bfloat16",
              cache_type: str = "", hbm_bytes: int | None = None,
-             draft_cfg=None) -> MemoryEstimate:
-    """Serving-memory estimate for a Llama-family config at the given engine
-    shape (reference role: initializers' VRAM guesser guarding LoadModel)."""
+             draft_cfg=None, shards: int = 1) -> MemoryEstimate:
+    """PER-CHIP serving-memory estimate for a Llama-family config at the
+    given engine shape (reference role: initializers' VRAM guesser guarding
+    LoadModel). `shards` = mesh device count — GSPMD TP/EP divides weights
+    and KV across chips."""
     wbytes = int(param_count(cfg) * _DTYPE_BYTES.get(dtype, 2))
     if _DTYPE_BYTES.get(dtype, 2) < 2:
         # quantized weights carry f32 per-channel scales (~1/in_dim overhead)
@@ -106,6 +108,9 @@ def estimate(cfg, *, slots: int, context: int, dtype: str = "bfloat16",
         wbytes += int(param_count(draft_cfg) * _DTYPE_BYTES.get(dtype, 2))
         kv += (2 * draft_cfg.num_layers * slots * draft_cfg.num_kv_heads
                * context * draft_cfg.head_dim * 2)
+
+    wbytes = wbytes // max(shards, 1)
+    kv = kv // max(shards, 1)
 
     # working set: logits [slots, V] f32 ×2 (last + sampled), sampler state,
     # transient fusion buffers — a conservative 512MB + logits

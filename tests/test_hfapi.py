@@ -1,0 +1,90 @@
+"""HF Inference-API passthrough backend against a local fake endpoint."""
+import json
+import threading
+
+import pytest
+
+
+@pytest.fixture()
+def fake_hf():
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    seen = []
+
+    class H(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            body = json.loads(self.rfile.read(
+                int(self.headers["Content-Length"])))
+            seen.append({"path": self.path, "body": body,
+                         "auth": self.headers.get("Authorization", "")})
+            out = json.dumps([{
+                "generated_text": f"echo:{body['inputs']} STOP tail"}]).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.end_headers()
+            self.wfile.write(out)
+
+    srv = HTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}", seen
+    srv.shutdown()
+
+
+def test_predict_roundtrip(fake_hf):
+    from localai_tpu.backend import pb
+    from localai_tpu.backend.hfapi import HFApiServicer
+
+    url, seen = fake_hf
+    s = HFApiServicer()
+    r = s.LoadModel(pb.ModelOptions(
+        model="org/some-model",
+        options=json.dumps({"endpoint": url, "token": "tok-1"})), None)
+    assert r.success, r.message
+
+    reply = s.Predict(pb.PredictOptions(
+        prompt="hello", tokens=16, temperature=0.5,
+        stop_prompts=["STOP"]), _Ctx())
+    assert reply.message.decode() == "echo:hello "
+    assert seen[0]["path"] == "/org/some-model"
+    assert seen[0]["auth"] == "Bearer tok-1"
+    assert seen[0]["body"]["parameters"]["max_new_tokens"] == 16
+
+    chunks = list(s.PredictStream(pb.PredictOptions(prompt="x"), _Ctx()))
+    assert len(chunks) == 1 and chunks[0].message.decode().startswith("echo:x")
+
+
+def test_requires_token(monkeypatch):
+    from localai_tpu.backend import pb
+    from localai_tpu.backend.hfapi import HFApiServicer
+
+    monkeypatch.delenv("HUGGINGFACEHUB_API_TOKEN", raising=False)
+    s = HFApiServicer()
+    r = s.LoadModel(pb.ModelOptions(model="m"), None)
+    assert not r.success and "token" in r.message
+
+
+def test_served_role_spawns(fake_hf):
+    """Through the real gRPC server process role registry."""
+    from localai_tpu.backend.client import BackendClient
+    from localai_tpu.backend.server import serve
+
+    url, _ = fake_hf
+    server, servicer, port = serve("127.0.0.1:0", "huggingface")
+    try:
+        client = BackendClient(f"127.0.0.1:{port}")
+        assert client.wait_ready(attempts=20, sleep=0.1)
+        r = client.load_model(model="m", options=json.dumps(
+            {"endpoint": url, "token": "t"}))
+        assert r.success
+        out = client.predict(prompt="ping")
+        assert out.message.decode().startswith("echo:ping")
+    finally:
+        server.stop(grace=1)
+
+
+class _Ctx:
+    def abort(self, code, details):
+        raise AssertionError(f"{code}: {details}")
