@@ -38,8 +38,13 @@ class HFApiServicer(BackendServicer):
             if request.options:
                 try:
                     opts = json.loads(request.options)
-                except ValueError:
-                    pass
+                except ValueError as e:
+                    # a typo'd options blob must not silently fall back to
+                    # the public endpoint with the env token
+                    self._state = pb.StatusResponse.ERROR
+                    return pb.Result(
+                        success=False,
+                        message=f"invalid options JSON: {e}")
             token = (opts.get("token")
                      or os.environ.get("HUGGINGFACEHUB_API_TOKEN", ""))
             if not token:

@@ -115,10 +115,10 @@ class LLMServicer(BackendServicer):
 
         from localai_tpu.system.memory import estimate
 
-        # the estimate is per chip: a TP mesh shards weights + KV over the
-        # model axis (a replica-per-data-shard would not divide weights, but
-        # the auto mesh here is data=1)
-        shards = 1 if mesh is None else int(mesh.devices.size)
+        # the estimate is per chip: only the TP ('model') axis shards
+        # weights and KV — data-parallel replicas hold full copies
+        shards = 1 if mesh is None else int(
+            dict(zip(mesh.axis_names, mesh.devices.shape)).get("model", 1))
         est = estimate(cfg, slots=request.parallel or 4,
                        context=context_size,
                        dtype=request.dtype or cfg.dtype,

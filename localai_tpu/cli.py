@@ -169,6 +169,52 @@ def _add_worker(sub):
     return p
 
 
+def _add_util(sub):
+    p = sub.add_parser("util",
+                       help="model utilities (reference: core/cli util cmd)")
+    p.add_argument("action", choices=["hf-info", "fits"],
+                   help="hf-info: checkpoint geometry + params; "
+                            "fits: HBM fit estimate")
+    p.add_argument("model", help="checkpoint directory")
+    p.add_argument("--slots", type=int, default=8)
+    p.add_argument("--context", type=int, default=2048)
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--cache-type", default="")
+    p.add_argument("--hbm-gb", type=float, default=None)
+    return p
+
+
+def cli_util(args) -> int:
+    import json as _json
+
+    from localai_tpu.engine.loader import load_config
+    from localai_tpu.system.memory import estimate, param_count
+
+    cfg = load_config(args.model)
+    if args.action == "hf-info":
+        print(_json.dumps({
+            "architecture": "llama-family",
+            "hidden_size": cfg.hidden_size,
+            "layers": cfg.num_layers,
+            "heads": cfg.num_heads,
+            "kv_heads": cfg.num_kv_heads,
+            "head_dim": cfg.head_dim,
+            "intermediate_size": cfg.intermediate_size,
+            "vocab_size": cfg.vocab_size,
+            "max_position": cfg.max_position,
+            "num_experts": cfg.num_experts,
+            "rope_scaling": cfg.rope_scaling,
+            "parameters": param_count(cfg),
+        }, indent=1))
+        return 0
+    hbm = int(args.hbm_gb * 2**30) if args.hbm_gb else None
+    est = estimate(cfg, slots=args.slots, context=args.context,
+                   dtype=args.dtype, cache_type=args.cache_type,
+                   hbm_bytes=hbm)
+    print(_json.dumps(est.to_dict(), indent=1))
+    return 0
+
+
 def _add_launcher(sub):
     p = sub.add_parser("launcher",
                        help="interactive server controller "
@@ -261,6 +307,7 @@ def main(argv=None):
     _add_backends(sub)
     _add_explorer(sub)
     _add_launcher(sub)
+    _add_util(sub)
     _add_federated(sub)
     _add_worker(sub)
     _add_tts(sub)
@@ -296,6 +343,8 @@ def main(argv=None):
         from localai_tpu.launcher import run_launcher
 
         return run_launcher(args)
+    if cmd == "util":
+        return cli_util(args)
     if cmd == "federated":
         from localai_tpu.federation import run_federated
 

@@ -62,3 +62,35 @@ def test_cli_transcript_formats(tmp_path, monkeypatch, whisper_models_dir,
     out = capsys.readouterr().out
     payload = json.loads(out[out.index("{"):])
     assert "text" in payload and "segments" in payload
+
+
+def test_util_hf_info_and_fits(tmp_path):
+    import json
+    import subprocess
+    import sys
+
+    cfg = {"architectures": ["LlamaForCausalLM"], "vocab_size": 1000,
+           "hidden_size": 64, "intermediate_size": 128,
+           "num_hidden_layers": 2, "num_attention_heads": 4,
+           "num_key_value_heads": 2, "head_dim": 16,
+           "max_position_embeddings": 256, "rms_norm_eps": 1e-5}
+    (tmp_path / "config.json").write_text(json.dumps(cfg))
+    env = dict(__import__("os").environ)
+    repo = __import__("os").path.dirname(
+        __import__("os").path.dirname(__import__("os").path.abspath(__file__)))
+    env["PYTHONPATH"] = repo
+
+    out = subprocess.run(
+        [sys.executable, "-m", "localai_tpu.cli", "util", "hf-info",
+         str(tmp_path)], capture_output=True, text=True, timeout=120, env=env)
+    assert out.returncode == 0, out.stderr
+    info = json.loads(out.stdout)
+    assert info["layers"] == 2 and info["parameters"] > 0
+
+    out = subprocess.run(
+        [sys.executable, "-m", "localai_tpu.cli", "util", "fits",
+         str(tmp_path), "--hbm-gb", "16"],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert out.returncode == 0, out.stderr
+    fit = json.loads(out.stdout)
+    assert fit["fits"] is True
