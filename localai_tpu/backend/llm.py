@@ -115,14 +115,17 @@ class LLMServicer(BackendServicer):
 
         from localai_tpu.system.memory import estimate
 
-        # the estimate is per chip: only the TP ('model') axis shards
-        # weights and KV — data-parallel replicas hold full copies
+        # per chip: weights shard over the TP ('model') axis only (data
+        # replicas hold full copies); the KV cache shards over both axes
+        # (kv_cache_spec: slots on 'data', kv heads on 'model')
         shards = 1 if mesh is None else int(
             dict(zip(mesh.axis_names, mesh.devices.shape)).get("model", 1))
+        kv_shards = 1 if mesh is None else int(mesh.devices.size)
         est = estimate(cfg, slots=request.parallel or 4,
                        context=context_size,
                        dtype=request.dtype or cfg.dtype,
-                       cache_type=kv_kind, draft_cfg=dcfg, shards=shards)
+                       cache_type=kv_kind, draft_cfg=dcfg, shards=shards,
+                       kv_shards=kv_shards)
         if est.fits is False:
             import logging
 

@@ -207,10 +207,18 @@ def cli_util(args) -> int:
             "parameters": param_count(cfg),
         }, indent=1))
         return 0
-    hbm = int(args.hbm_gb * 2**30) if args.hbm_gb else None
+    if args.hbm_gb:
+        hbm = int(args.hbm_gb * 2**30)
+    else:
+        # table lookup only — a pre-flight CLI must never init a PJRT
+        # client (it would contend for the chip with a running server)
+        from localai_tpu.system.capabilities import detect_capability
+        from localai_tpu.system.memory import hbm_table_bytes
+
+        hbm = hbm_table_bytes(detect_capability())
     est = estimate(cfg, slots=args.slots, context=args.context,
                    dtype=args.dtype, cache_type=args.cache_type,
-                   hbm_bytes=hbm)
+                   hbm_bytes=hbm, detect_hbm=False)
     print(_json.dumps(est.to_dict(), indent=1))
     return 0
 

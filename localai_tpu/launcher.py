@@ -37,12 +37,6 @@ class Launcher:
     def start(self) -> bool:
         if self.running:
             return True
-        if self.proc is not None:
-            # reap a crashed previous server before replacing it
-            try:
-                self.proc.wait(timeout=0.1)
-            except subprocess.TimeoutExpired:
-                pass
         argv = [sys.executable, "-m", "localai_tpu.cli", "run",
                 "--address", self.address,
                 "--models-path", self.models_path] + self.extra_args

@@ -88,11 +88,15 @@ def detect_hbm_bytes() -> int | None:
 
 def estimate(cfg, *, slots: int, context: int, dtype: str = "bfloat16",
              cache_type: str = "", hbm_bytes: int | None = None,
-             draft_cfg=None, shards: int = 1) -> MemoryEstimate:
+             draft_cfg=None, shards: int = 1,
+             kv_shards: int | None = None,
+             detect_hbm: bool = True) -> MemoryEstimate:
     """PER-CHIP serving-memory estimate for a Llama-family config at the
     given engine shape (reference role: initializers' VRAM guesser guarding
-    LoadModel). `shards` = mesh device count — GSPMD TP/EP divides weights
-    and KV across chips."""
+    LoadModel). `shards` divides the weights (the TP 'model' axis — data
+    replicas hold full copies); `kv_shards` divides the KV cache (sharded
+    over BOTH axes: slots on 'data', kv heads on 'model'; defaults to
+    `shards`)."""
     wbytes = int(param_count(cfg) * _DTYPE_BYTES.get(dtype, 2))
     if _DTYPE_BYTES.get(dtype, 2) < 2:
         # quantized weights carry f32 per-channel scales (~1/in_dim overhead)
@@ -110,12 +114,14 @@ def estimate(cfg, *, slots: int, context: int, dtype: str = "bfloat16",
                * context * draft_cfg.head_dim * 2)
 
     wbytes = wbytes // max(shards, 1)
-    kv = kv // max(shards, 1)
+    kv = kv // max(kv_shards if kv_shards is not None else shards, 1)
 
     # working set: logits [slots, V] f32 ×2 (last + sampled), sampler state,
     # transient fusion buffers — a conservative 512MB + logits
     working = 2 * slots * cfg.vocab_size * 4 + (512 << 20)
 
-    hbm = hbm_bytes if hbm_bytes is not None else detect_hbm_bytes()
+    hbm = hbm_bytes
+    if hbm is None and detect_hbm:
+        hbm = detect_hbm_bytes()
     total = wbytes + kv + working
     return MemoryEstimate(wbytes, kv, working, total, hbm)
