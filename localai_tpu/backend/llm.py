@@ -237,6 +237,8 @@ class LLMServicer(BackendServicer):
             logprobs=request.logprobs,
             grammar=request.grammar,
             context_shift=request.context_shift,
+            prompt_cache_path=request.prompt_cache_path,
+            prompt_cache_ro=request.prompt_cache_ro,
         )
         try:
             return self.engine.submit(req)

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import dataclasses
 import queue
+import os
 import threading
 import time
 from functools import partial
@@ -96,6 +97,10 @@ class GenRequest:
     grammar: str = ""             # GBNF; enforced via native matcher masks
     context_shift: bool = False   # evict-and-continue past max_context
                                   # (reference ctx_shift, backend.proto:22)
+    prompt_cache_path: str = ""   # persist/reuse this prompt's KV on disk
+                                  # (reference PromptCachePath,
+                                  # backend.proto:136-142)
+    prompt_cache_ro: bool = False  # reuse only; never rewrite the file
 
 
 @dataclasses.dataclass
@@ -129,6 +134,8 @@ class _Slot:
     row: Any = None                  # sampler row (installed at final chunk)
     counts_row: Any = None
     shifted: int = 0                 # tokens evicted by context shifts
+    disk_prefix: int = 0             # prefix length loaded from the disk
+                                     # prompt cache (skip the re-save)
 
 
 class Engine:
@@ -598,6 +605,9 @@ class Engine:
             return False
         slot, lcp = self._pick_slot(req.prompt_ids)
         self._slot_kv_tokens[slot] = []
+        disk_prefix = 0
+        if not lcp and req.prompt_cache_path:
+            lcp = disk_prefix = self._load_prompt_cache(slot, req)
         if lcp:
             # shared prefix already in this slot's cache: prefill only the
             # suffix via the chunked-extend path (start offset = lcp)
@@ -622,7 +632,7 @@ class Engine:
             matcher=matcher,
             start_time=time.monotonic(), prompt_len=n,
             prefilled=not chunked, row=row, counts_row=counts_row,
-            prefill_pos=lcp,
+            prefill_pos=lcp, disk_prefix=disk_prefix,
         )
         self._slots[slot] = slot_obj
         if chunked:
@@ -906,7 +916,99 @@ class Engine:
         self._free.remove(cold)
         return cold, 0
 
+    # --------------------------------------------- disk prompt cache
+    # (reference PromptCachePath/PromptCacheAll/PromptCacheRO — llama.cpp
+    # persists a prompt's KV to a file and restores it across restarts)
+
+    def _load_prompt_cache(self, slot: int, req: GenRequest) -> int:
+        """Restore a saved KV prefix into `slot` if the file's tokens prefix
+        this prompt. Returns the reusable length (0 = cold)."""
+        if self.mesh is not None or self._draft is not None:
+            return 0
+        try:
+            with np.load(req.prompt_cache_path, allow_pickle=False) as z:
+                tokens = z["tokens"].tolist()
+                leaves = {k: z[k] for k in z.files if k != "tokens"}
+        except Exception:
+            # corrupt/truncated/foreign files raise a zoo (BadZipFile,
+            # zlib.error, ValueError...) — all of them mean cold prefill,
+            # never a dead engine
+            return 0
+        limit = self.ec.max_context - 2 - self._ctx_reserve
+        m = min(len(tokens), len(req.prompt_ids) - 1, limit - 1)
+        lcp = 0
+        while lcp < m and tokens[lcp] == req.prompt_ids[lcp]:
+            lcp += 1
+        if lcp < self.ec.prompt_cache_min:
+            return 0
+        try:
+            self._kc, self._vc = self._cache_inject(
+                self._kc, self._vc, slot, leaves, lcp)
+        except Exception:
+            return 0
+        return lcp
+
+    def _cache_inject(self, kc, vc, slot: int, leaves: dict, n: int):
+        """Write saved KV rows [L, KVH, n, D] into slot's cache region."""
+        from localai_tpu.ops.kvcache import QuantKV
+
+        if isinstance(kc, QuantKV):
+            kc = QuantKV(kc.q.at[:, slot, :, :n].set(leaves["kq"][:, :, :n]),
+                         kc.s.at[:, slot].set(leaves["ks"]))
+            vc = QuantKV(vc.q.at[:, slot, :, :n].set(leaves["vq"][:, :, :n]),
+                         vc.s.at[:, slot].set(leaves["vs"]))
+            return kc, vc
+        kc = kc.at[:, slot, :, :n].set(
+            jnp.asarray(leaves["k"][:, :, :n], kc.dtype))
+        vc = vc.at[:, slot, :, :n].set(
+            jnp.asarray(leaves["v"][:, :, :n], vc.dtype))
+        return kc, vc
+
+    def _save_prompt_cache(self, idx: int, slot: _Slot):
+        """Persist the slot's prompt-KV rows + token ids to the request's
+        cache file (skipped for RO requests, meshes, shifted slots)."""
+        if (not slot.req.prompt_cache_path or slot.req.prompt_cache_ro
+                or self.mesh is not None or self._draft is not None
+                or slot.shifted or not slot.prefilled):
+            return
+        n = min(slot.prompt_len, self.ec.max_context - 2)
+        if slot.disk_prefix >= n - 1:
+            return   # the file already covers this prompt — skip the
+                     # device→host transfer + rewrite (hot shared prefix)
+        try:
+            from localai_tpu.ops.kvcache import QuantKV
+
+            if isinstance(self._kc, QuantKV):
+                leaves = {
+                    "kq": np.asarray(self._kc.q[:, idx, :, :n]),
+                    "ks": np.asarray(self._kc.s[:, idx]),
+                    "vq": np.asarray(self._vc.q[:, idx, :, :n]),
+                    "vs": np.asarray(self._vc.s[:, idx]),
+                }
+            else:
+                # f32 on disk: npz round-trips bfloat16 as raw void bytes
+                # that cannot cast back — upcast once here instead
+                leaves = {
+                    "k": np.asarray(self._kc[:, idx, :, :n]).astype(
+                        np.float32),
+                    "v": np.asarray(self._vc[:, idx, :, :n]).astype(
+                        np.float32),
+                }
+            tmp = slot.req.prompt_cache_path + ".tmp"
+            with open(tmp, "wb") as f:   # file handle: savez must not
+                np.savez(f, tokens=np.asarray(   # append its own .npz
+                    slot.req.prompt_ids[:n], np.int64), **leaves)
+            os.replace(tmp, slot.req.prompt_cache_path)
+        except Exception:   # best-effort: a faulted device or full disk
+                            # must not break _fail_active's cleanup loop
+            import logging
+
+            logging.getLogger("localai_tpu").warning(
+                "failed to write prompt cache %s",
+                slot.req.prompt_cache_path, exc_info=True)
+
     def _release_slot(self, idx: int, slot: _Slot):
+        self._save_prompt_cache(idx, slot)
         if slot.matcher is not None:
             self._mask_host[idx] = 0xFF
             self._grammar_slots -= 1

@@ -1,0 +1,151 @@
+"""Disk prompt-KV persistence (reference PromptCachePath/All/RO,
+backend.proto:136-142): a prompt's KV survives an engine restart."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from localai_tpu.engine import Engine, EngineConfig
+from localai_tpu.engine.engine import GenRequest, SamplingParams
+from localai_tpu.models.llama import LlamaConfig, init_params
+
+CFG = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                  num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+                  max_position=256, dtype="float32")
+
+
+def _engine(cache_type=""):
+    params = init_params(CFG, jax.random.PRNGKey(0), jnp.float32)
+    return Engine(CFG, params, None, EngineConfig(
+        max_slots=2, max_context=128, prefill_buckets=(64,),
+        prefill_chunk=64, cache_type=cache_type))
+
+
+def _run(eng, prompt, path="", ro=False, seed=5):
+    _, q = eng.submit(GenRequest(
+        prompt_ids=prompt, max_tokens=5, ignore_eos=True,
+        params=SamplingParams(temperature=0.0, seed=seed),
+        prompt_cache_path=path, prompt_cache_ro=ro))
+    toks = []
+    while True:
+        o = q.get(timeout=60)
+        toks.append(o.token_id)
+        if o.finished:
+            return toks
+
+
+@pytest.mark.parametrize("cache_type", ["", "int8"])
+def test_kv_survives_engine_restart(tmp_path, cache_type):
+    path = str(tmp_path / "prompt.kv.npz")
+    prompt = list(range(1, 41))
+
+    e1 = _engine(cache_type)
+    e1.start()
+    try:
+        ref = _run(e1, prompt, path=path)
+    finally:
+        e1.stop()
+    assert (tmp_path / "prompt.kv.npz").exists()
+
+    # fresh engine (restart): same prompt must reuse the saved prefix AND
+    # produce the same output
+    e2 = _engine(cache_type)
+    e2.start()
+    try:
+        out = _run(e2, prompt, path=path)
+        assert e2.metrics["prompt_tokens_reused"] == len(prompt) - 1
+        assert out == ref
+    finally:
+        e2.stop()
+
+
+def test_ro_does_not_write(tmp_path):
+    path = str(tmp_path / "ro.kv.npz")
+    eng = _engine()
+    eng.start()
+    try:
+        _run(eng, list(range(1, 30)), path=path, ro=True)
+    finally:
+        eng.stop()
+    assert not (tmp_path / "ro.kv.npz").exists()
+
+
+def test_corrupt_file_falls_back_cold(tmp_path):
+    path = tmp_path / "bad.kv.npz"
+    path.write_bytes(b"this is not an npz")
+    eng = _engine()
+    eng.start()
+    try:
+        toks = _run(eng, list(range(1, 30)), path=str(path))
+        assert len(toks) == 5
+        assert eng.metrics["prompt_tokens_reused"] == 0
+    finally:
+        eng.stop()
+
+
+def test_mismatched_prompt_ignored(tmp_path):
+    path = str(tmp_path / "other.kv.npz")
+    e1 = _engine()
+    e1.start()
+    try:
+        _run(e1, list(range(1, 41)), path=path)
+    finally:
+        e1.stop()
+
+    e2 = _engine()
+    e2.start()
+    try:
+        _run(e2, list(range(60, 100)), path=path)   # disjoint prompt
+        assert e2.metrics["prompt_tokens_reused"] == 0
+    finally:
+        e2.stop()
+
+
+def test_bf16_cache_roundtrips(tmp_path):
+    """bfloat16 KV (the default model dtype) must survive the npz round trip
+    (npz stores bf16 as raw void bytes — the save path upcasts to f32)."""
+    import dataclasses
+
+    path = str(tmp_path / "bf16.kv.npz")
+    cfg = dataclasses.replace(CFG, dtype="bfloat16")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    prompt = list(range(1, 41))
+
+    def engine():
+        return Engine(cfg, params, None, EngineConfig(
+            max_slots=2, max_context=128, prefill_buckets=(64,),
+            prefill_chunk=64))
+
+    e1 = engine()
+    e1.start()
+    try:
+        ref = _run(e1, prompt, path=path)
+    finally:
+        e1.stop()
+
+    e2 = engine()
+    e2.start()
+    try:
+        out = _run(e2, prompt, path=path)
+        assert e2.metrics["prompt_tokens_reused"] == len(prompt) - 1
+        assert out == ref
+    finally:
+        e2.stop()
+
+
+def test_zip_magic_corrupt_file_survives(tmp_path):
+    """A file with zip magic but garbage content (BadZipFile territory) must
+    cold-prefill, not kill the engine."""
+    path = tmp_path / "zip.kv.npz"
+    path.write_bytes(b"PK\x03\x04" + b"\x00" * 64)
+    eng = _engine()
+    eng.start()
+    try:
+        toks = _run(eng, list(range(1, 30)), path=str(path))
+        assert len(toks) == 5
+        assert eng.metrics["prompt_tokens_reused"] == 0
+        # engine still alive for the next request
+        toks2 = _run(eng, list(range(1, 20)))
+        assert len(toks2) == 5
+    finally:
+        eng.stop()
