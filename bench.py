@@ -103,42 +103,55 @@ def probe_accelerator(args) -> tuple[bool, str, str]:
         return True, "", "cpu"
     import subprocess
 
-    probe_timeout = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", "900"))
-    note(f"probing accelerator ({probe_timeout}s limit)...")
+    total = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", "900"))
+    # a flaky tunnel can hang one client-creation attempt and accept the
+    # next — split the budget into escalating attempts (the last one long
+    # enough for a legitimately slow cold init)
+    ladder = [max(60, int(total * f)) for f in (0.25, 0.25, 0.5)]
     code = ("import time,jax; t=time.time(); d=jax.devices()[0]; "
             "print('PROBE_OK', d.platform, getattr(d,'device_kind',''), "
             "f'{time.time()-t:.0f}s', flush=True)")
-    try:
-        probe = subprocess.run([sys.executable, "-c", code],
-                               capture_output=True, text=True,
-                               timeout=probe_timeout)
-        ok = [l for l in (probe.stdout or "").splitlines()
-              if l.startswith("PROBE_OK")]
-        if probe.returncode != 0 or not ok:
-            tail = (probe.stderr or "").strip().splitlines()[-8:]
-            err = f"rc={probe.returncode}: " + " | ".join(tail)
-            note(f"probe FAILED — {err}")
-            note("falling back to CPU (results will be non-comparable)")
-            return True, err, "cpu"
-        note(f"probe ok: {ok[-1]}")
-        platform = ok[-1].split()[1]
-        kind = " ".join(ok[-1].split()[2:-1]) or platform
-        if platform == "cpu":
-            # a TPU-less machine: run the CPU smoke, never publish it as a
-            # comparable per-chip number
-            note("probe found only CPU — results will be non-comparable")
-            return True, "", "cpu"
-        return False, "", kind
-    except subprocess.TimeoutExpired as e:
-        tail = ""
-        for s in (e.stderr, e.stdout):
-            if s:
-                s = s if isinstance(s, str) else s.decode(errors="replace")
-                tail += " | ".join(s.strip().splitlines()[-4:])
-        err = f"init timed out after {probe_timeout}s: {tail}"
-        note(f"probe TIMED OUT — {err}")
-        note("falling back to CPU (results will be non-comparable)")
-        return True, err, "cpu"
+    err = ""
+    hard_fails = 0
+    for attempt, probe_timeout in enumerate(ladder, 1):
+        note(f"probing accelerator (attempt {attempt}/{len(ladder)}, "
+             f"{probe_timeout}s limit)...")
+        try:
+            probe = subprocess.run([sys.executable, "-c", code],
+                                   capture_output=True, text=True,
+                                   timeout=probe_timeout)
+            ok = [l for l in (probe.stdout or "").splitlines()
+                  if l.startswith("PROBE_OK")]
+            if probe.returncode != 0 or not ok:
+                tail = (probe.stderr or "").strip().splitlines()[-8:]
+                err = f"rc={probe.returncode}: " + " | ".join(tail)
+                note(f"probe FAILED — {err}")
+                # fast non-timeout failures are usually deterministic
+                # (missing libtpu etc.) — one retry covers the transient
+                # connection-refused case, then stop burning the budget
+                hard_fails += 1
+                if hard_fails >= 2:
+                    break
+                continue
+            note(f"probe ok: {ok[-1]}")
+            platform = ok[-1].split()[1]
+            kind = " ".join(ok[-1].split()[2:-1]) or platform
+            if platform == "cpu":
+                # a TPU-less machine: run the CPU smoke, never publish it as
+                # a comparable per-chip number
+                note("probe found only CPU — results will be non-comparable")
+                return True, "", "cpu"
+            return False, "", kind
+        except subprocess.TimeoutExpired as e:
+            tail = ""
+            for s in (e.stderr, e.stdout):
+                if s:
+                    s = s if isinstance(s, str) else s.decode(errors="replace")
+                    tail += " | ".join(s.strip().splitlines()[-4:])
+            err = f"init timed out after {probe_timeout}s: {tail}"
+            note(f"probe TIMED OUT — {err}")
+    note("falling back to CPU (results will be non-comparable)")
+    return True, err, "cpu"
 
 
 # --------------------------------------------------------------- serve mode
