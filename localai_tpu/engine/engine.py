@@ -82,6 +82,9 @@ class EngineConfig:
                                   # prompt shares it (llama.cpp prompt/slot
                                   # cache role, backend.proto:136-142)
     prompt_cache_min: int = 16    # minimum shared prefix worth reusing
+    sampling_topk_width: int = 64  # sort-free decode sampling when every
+                                   # active slot's top_k fits this width
+                                   # (0 disables; see ops/sampling.sample)
 
 
 @dataclasses.dataclass
@@ -136,6 +139,7 @@ class _Slot:
     shifted: int = 0                 # tokens evicted by context shifts
     disk_prefix: int = 0             # prefix length loaded from the disk
                                      # prompt cache (skip the re-save)
+    fast_ok: bool = False            # sampling fits the sort-free top-k path
 
 
 class Engine:
@@ -294,9 +298,10 @@ class Engine:
             return kc, vc, sampler, last_logits, lengths
 
         def _decode(params, cos, sin, kc, vc, sampler, last_logits, lengths,
-                    active, mask_bits):
+                    active, mask_bits, fast_width=None):
             """sample(prev logits) → decode → next logits, for all slots."""
-            tokens, keys, logprobs = sample(last_logits, sampler, mask_bits)
+            tokens, keys, logprobs = sample(last_logits, sampler, mask_bits,
+                                            topk_width=fast_width)
             logits, kc, vc = decode_step(
                 params, cfg, tokens, lengths, cos, sin, kc, vc, active
             )
@@ -356,9 +361,13 @@ class Engine:
                                   static_argnames=())
         self._decode_nomask_fn = jax.jit(
             partial(_decode, mask_bits=None), donate_argnums=(3, 4, 5, 6, 7))
+        self._decode_fast_fn = jax.jit(
+            partial(_decode, mask_bits=None,
+                    fast_width=self.ec.sampling_topk_width or None),
+            donate_argnums=(3, 4, 5, 6, 7))
 
         def _decode_block(params, cos, sin, kc, vc, sampler, last_logits,
-                          lengths, active, *, steps: int):
+                          lengths, active, *, steps: int, fast_width=None):
             """`steps` fused sample→decode iterations in ONE device program.
 
             One dispatch + one result fetch per `steps` tokens: on a remote
@@ -370,7 +379,7 @@ class Engine:
                 kc, vc, sampler, last_logits, lengths = carry
                 tokens, logprobs, kc, vc, sampler, last_logits, lengths = (
                     _decode(params, cos, sin, kc, vc, sampler, last_logits,
-                            lengths, active, None))
+                            lengths, active, None, fast_width))
                 return (kc, vc, sampler, last_logits, lengths), (tokens,
                                                                  logprobs)
             carry = (kc, vc, sampler, last_logits, lengths)
@@ -380,7 +389,7 @@ class Engine:
 
         self._decode_block_fn = jax.jit(
             _decode_block, donate_argnums=(3, 4, 5, 6, 7),
-            static_argnames=("steps",))
+            static_argnames=("steps", "fast_width"))
 
     # ------------------------------------------------------ device dispatch
     # Every device call goes through one of these. On a multi-host mesh the
@@ -432,9 +441,10 @@ class Engine:
                 {k: jnp.asarray(v) for k, v in row.items()},
                 jnp.asarray(counts_row))
 
-    def _dev_decode(self, active, mask_host=None):
+    def _dev_decode(self, active, mask_host=None, fast_width=None):
         self._bcast("decode", active=active,
-                    mask=None if mask_host is None else mask_host)
+                    mask=None if mask_host is None else mask_host,
+                    fast_width=fast_width)
         with activate_mesh(self.mesh):
             args = (self.params, self._cos, self._sin,
                     self._kc, self._vc, self._sampler, self._last_logits,
@@ -443,20 +453,26 @@ class Engine:
                 (tokens, logprobs, self._kc, self._vc, self._sampler,
                  self._last_logits, self._lengths) = self._decode_fn(
                     *args, jnp.asarray(mask_host))
+            elif fast_width:
+                (tokens, logprobs, self._kc, self._vc, self._sampler,
+                 self._last_logits, self._lengths) = self._decode_fast_fn(
+                    *args)
             else:
                 (tokens, logprobs, self._kc, self._vc, self._sampler,
                  self._last_logits, self._lengths) = self._decode_nomask_fn(
                     *args)
         return tokens, logprobs
 
-    def _dev_decode_block(self, active, steps: int):
-        self._bcast("decode_block", active=active, steps=steps)
+    def _dev_decode_block(self, active, steps: int, fast_width=None):
+        self._bcast("decode_block", active=active, steps=steps,
+                    fast_width=fast_width)
         with activate_mesh(self.mesh):
             (tokens, logprobs, self._kc, self._vc, self._sampler,
              self._last_logits, self._lengths) = self._decode_block_fn(
                 self.params, self._cos, self._sin,
                 self._kc, self._vc, self._sampler, self._last_logits,
-                self._lengths, jnp.asarray(active), steps=steps)
+                self._lengths, jnp.asarray(active), steps=steps,
+                fast_width=fast_width)
         return tokens, logprobs
 
     def _dev_shift(self, idx):
@@ -510,9 +526,11 @@ class Engine:
                 self._dev_extend_final(kw["buf"], kw["pos"], kw["nvalid"],
                                        kw["idx"], kw["row"], kw["counts_row"])
             elif op == "decode":
-                self._dev_decode(kw["active"], kw["mask"])
+                self._dev_decode(kw["active"], kw["mask"],
+                                 kw.get("fast_width"))
             elif op == "decode_block":
-                self._dev_decode_block(kw["active"], int(kw["steps"]))
+                self._dev_decode_block(kw["active"], int(kw["steps"]),
+                                       kw.get("fast_width"))
             elif op == "shift":
                 self._dev_shift(kw["idx"])
             elif op == "draft_ingest":
@@ -626,13 +644,18 @@ class Engine:
             if self._draft is not None:
                 self._dev_draft_ingest(ids, 0, slot)
 
+        W = self.ec.sampling_topk_width
+        p = req.params
+        fast_ok = bool(W and not req.grammar
+                       and 0 < (p.top_k or 0) <= W
+                       and (p.typical_p is None or p.typical_p >= 1.0))
         slot_obj = _Slot(
             request_id=rid, req=req, out=out,
             detok=self.tok.stream_decoder() if self.tok else None,
             matcher=matcher,
             start_time=time.monotonic(), prompt_len=n,
             prefilled=not chunked, row=row, counts_row=counts_row,
-            prefill_pos=lcp, disk_prefix=disk_prefix,
+            prefill_pos=lcp, disk_prefix=disk_prefix, fast_ok=fast_ok,
         )
         self._slots[slot] = slot_obj
         if chunked:
@@ -723,12 +746,19 @@ class Engine:
             return None
         entries = [(int(i), self._slots[i].request_id)
                    for i in np.where(active)[0]]
+        # sort-free sampling only when EVERY active slot's knobs fit the
+        # top-k window (and no grammar masks are live)
+        fast = (self.ec.sampling_topk_width or None) if (
+            self._grammar_slots == 0
+            and all(self._slots[i] is not None and self._slots[i].fast_ok
+                    for i, _ in entries)) else None
         steps = self._block_steps()
         if steps > 1:
-            tokens, logprobs = self._dev_decode_block(active, steps)
+            tokens, logprobs = self._dev_decode_block(active, steps, fast)
         else:
             tokens, logprobs = self._dev_decode(
-                active, self._mask_host if self._grammar_slots > 0 else None)
+                active, self._mask_host if self._grammar_slots > 0 else None,
+                fast)
         return tokens, logprobs, entries
 
     def _consume(self, pend):

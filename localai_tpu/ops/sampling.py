@@ -153,14 +153,17 @@ def _filtered_sorted(logits, state: SamplerState, mask_bits=None):
     # shared descending sort powers top-k / top-p / min-p / typical-p
     sorted_logits = -jnp.sort(-logits, axis=-1)                 # [B,V] desc
     order = jnp.argsort(-logits, axis=-1)                       # [B,V]
-    probs = jax.nn.softmax(sorted_logits, axis=-1)
-    cum = jnp.cumsum(probs, axis=-1)
 
     rank = jnp.arange(v)[None, :]
-    keep = jnp.ones((b, v), bool)
-    # top-k (0 = disabled)
+    # top-k first, then renormalize over the survivors: llama.cpp chains its
+    # samplers sequentially, and the sort-free fast path (_sample_topk) can
+    # only see the survivors — sequential semantics keep both paths equal in
+    # distribution
     k = jnp.where(state.top_k > 0, state.top_k, v)[:, None]
-    keep &= rank < k
+    keep = rank < k
+    probs = jax.nn.softmax(
+        jnp.where(keep, sorted_logits, NEG_INF), axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
     # top-p: keep smallest prefix with cum >= p (always keep rank 0)
     keep &= (cum - probs) < state.top_p[:, None]
     # min-p: prob >= min_p * max_prob
@@ -196,27 +199,30 @@ def sampling_probs(logits, state: SamplerState, mask_bits=None):
     ].set(p_sorted)
 
 
-def sample(logits, state: SamplerState, mask_bits=None):
+def sample(logits, state: SamplerState, mask_bits=None, topk_width=None):
     """One sampling step. logits: [B, V] (any float dtype).
 
     mask_bits: optional [B, ceil(V/8)] u8 allowed-token bitmask (LSB-first)
     from the grammar matcher — disallowed tokens are hard-masked before the
     truncation chain (the llama.cpp grammar-sampler role, applied on-device).
 
+    topk_width (static): decode fast path. A full [B, 128k] descending sort
+    is the dominant non-matmul cost of a decode step on TPU; when every
+    active slot has 0 < top_k <= width (the engine checks), lax.top_k over
+    `width` lanes replaces the two full sorts and top-p/min-p apply WITHIN
+    the top-k survivors — llama.cpp's sequential sampler-chain semantics.
+    Chosen-token logprobs stay exact (full-vocab logsumexp, no sort needed).
+
     Returns (tokens [B] i32, new_keys [B,2], logprobs [B] f32 of chosen token).
     """
+    if topk_width is not None:
+        if mask_bits is not None:
+            raise ValueError("grammar masks require the full sampling path "
+                             "(topk_width must be None)")
+        return _sample_topk(logits, state, topk_width)
     b, v = logits.shape
     masked, sorted_logits, order = _filtered_sorted(logits, state, mask_bits)
-    new_keys = jax.vmap(lambda kk: jax.random.split(jax.random.wrap_key_data(kk), 2))(
-        state.key
-    )
-    step_keys = jax.vmap(jax.random.wrap_key_data)(
-        jax.vmap(jax.random.key_data)(new_keys[:, 1])
-    )
-    sampled_rank = jax.vmap(lambda kk, lg: jax.random.categorical(kk, lg))(
-        step_keys, masked
-    )
-    sampled_rank = jnp.where(state.greedy, 0, sampled_rank)
+    sampled_rank, carry_keys = _draw(state, masked)
     tokens = jnp.take_along_axis(order, sampled_rank[:, None], axis=-1)[:, 0]
 
     # logprob of the chosen token under the PRE-truncation distribution
@@ -224,5 +230,49 @@ def sample(logits, state: SamplerState, mask_bits=None):
     # inflated by top-k/top-p renormalization.
     logprobs_sorted = jax.nn.log_softmax(sorted_logits, axis=-1)
     tok_logprob = jnp.take_along_axis(logprobs_sorted, sampled_rank[:, None], axis=-1)[:, 0]
-    carry_keys = jax.vmap(jax.random.key_data)(new_keys[:, 0]).astype(jnp.uint32)
+    return tokens.astype(jnp.int32), carry_keys, tok_logprob
+
+
+def _draw(state: SamplerState, masked):
+    """Shared PRNG step: split per-slot keys, draw a categorical rank from
+    the masked (NEG_INF-dropped) logits, greedy rows take rank 0.
+    Returns (sampled_rank [B], carry_keys [B,2] u32)."""
+    new_keys = jax.vmap(lambda kk: jax.random.split(
+        jax.random.wrap_key_data(kk), 2))(state.key)
+    step_keys = jax.vmap(jax.random.wrap_key_data)(
+        jax.vmap(jax.random.key_data)(new_keys[:, 1]))
+    sampled_rank = jax.vmap(lambda kk, lg: jax.random.categorical(kk, lg))(
+        step_keys, masked)
+    sampled_rank = jnp.where(state.greedy, 0, sampled_rank)
+    carry_keys = jax.vmap(jax.random.key_data)(new_keys[:, 0]).astype(
+        jnp.uint32)
+    return sampled_rank, carry_keys
+
+
+def _sample_topk(logits, state: SamplerState, width: int):
+    """Sort-free decode sampling over the top-`width` logits (see sample).
+    Sequential-chain semantics identical to _filtered_sorted for any slot
+    with 0 < top_k <= width and typical_p disabled."""
+    b, v = logits.shape
+    logits = pipeline_logits(logits, state, None)
+    vals, order = jax.lax.top_k(logits, width)                 # [B, W] desc
+    rank = jnp.arange(width)[None, :]
+    k = jnp.where(state.top_k > 0, state.top_k, width)[:, None]
+    keep = rank < k
+    # renormalize over the top-k survivors, THEN apply top-p/min-p — the
+    # same sequential chain as the full path
+    probs = jax.nn.softmax(jnp.where(keep, vals, NEG_INF), axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep &= (cum - probs) < state.top_p[:, None]
+    keep &= probs >= state.min_p[:, None] * probs[:, :1]
+    keep = keep.at[:, 0].set(True)
+    masked = jnp.where(keep, vals, NEG_INF)
+
+    sampled_rank, carry_keys = _draw(state, masked)
+    tokens = jnp.take_along_axis(order, sampled_rank[:, None], axis=-1)[:, 0]
+
+    # exact full-vocab logprob without a sort: val - logsumexp(all logits)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    tok_logprob = jnp.take_along_axis(
+        vals, sampled_rank[:, None], axis=-1)[:, 0] - lse
     return tokens.astype(jnp.int32), carry_keys, tok_logprob

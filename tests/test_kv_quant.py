@@ -212,3 +212,46 @@ def test_engine_int8_block_combined(block):
             assert len(toks) == 6
     finally:
         eng.stop()
+
+
+def test_fast_topk_sampler_parity():
+    """Sort-free decode sampling (sampling_topk_width): greedy rows match the
+    full path exactly, logprobs are full-vocab exact, and stochastic draws
+    stay inside the top-k set."""
+    import jax
+    import jax.numpy as jnp
+
+    from localai_tpu.ops.sampling import (
+        SamplerState, SamplingParams, sample, sampler_row,
+    )
+
+    B, V = 4, 512
+    logits = jax.random.normal(jax.random.PRNGKey(0), (B, V)) * 3.0
+    st = SamplerState.init(B, V)
+    rows = [sampler_row(SamplingParams(temperature=0.0, seed=1), V, 1),
+            sampler_row(SamplingParams(temperature=0.8, top_k=20, seed=2),
+                        V, 2),
+            sampler_row(SamplingParams(temperature=1.2, top_k=5, top_p=0.9,
+                                       seed=3), V, 3),
+            sampler_row(SamplingParams(temperature=0.0, seed=4), V, 4)]
+    import dataclasses as dc
+
+    fields = {}
+    for f in dc.fields(SamplerState):
+        cur = getattr(st, f.name)
+        if f.name == "token_counts":
+            fields[f.name] = cur
+        else:
+            fields[f.name] = jnp.stack([r[f.name] for r in rows])
+    st = SamplerState(**fields)
+
+    t_full, _, lp_full = sample(logits, st)
+    t_fast, _, lp_fast = sample(logits, st, topk_width=64)
+    # greedy rows (0 and 3) must match exactly, incl. logprob
+    for i in (0, 3):
+        assert int(t_full[i]) == int(t_fast[i]) == int(jnp.argmax(logits[i]))
+        assert abs(float(lp_full[i]) - float(lp_fast[i])) < 1e-4
+    # stochastic rows: drawn token must be inside the row's top-k set
+    for i, k in ((1, 20), (2, 5)):
+        topk = set(np.asarray(jax.lax.top_k(logits[i], k)[1]).tolist())
+        assert int(t_fast[i]) in topk
