@@ -32,5 +32,17 @@ def build_and_load(name: str) -> ctypes.CDLL:
             if r.returncode != 0:
                 raise RuntimeError(f"native build of {name} failed:\n{r.stderr}")
             os.replace(lib + ".tmp", lib)
-        _LIBS[name] = ctypes.CDLL(lib)
+        try:
+            _LIBS[name] = ctypes.CDLL(lib)
+        except OSError:
+            # a stale/foreign-arch .so (copied tree, cross-platform rsync):
+            # rebuild from source for THIS platform and retry once
+            cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                   "-o", lib + ".tmp", src]
+            r = subprocess.run(cmd, capture_output=True, text=True)
+            if r.returncode != 0:
+                raise RuntimeError(
+                    f"native rebuild of {name} failed:\n{r.stderr}")
+            os.replace(lib + ".tmp", lib)
+            _LIBS[name] = ctypes.CDLL(lib)
         return _LIBS[name]
