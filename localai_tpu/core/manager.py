@@ -119,7 +119,10 @@ class ModelManager:
     @staticmethod
     def _tail(name: str, proc: subprocess.Popen):
         for line in proc.stdout or []:
-            print(f"[backend:{name}] {line.rstrip()}", flush=True)
+            # stderr, not stdout: tools with a machine-readable stdout
+            # contract (bench.py's one-JSON-line output) embed the manager
+            print(f"[backend:{name}] {line.rstrip()}", file=sys.stderr,
+                  flush=True)
 
     def _load_rpc(self, handle: BackendHandle):
         cfg = self.app

@@ -85,6 +85,10 @@ class EngineConfig:
     sampling_topk_width: int = 64  # sort-free decode sampling when every
                                    # active slot's top_k fits this width
                                    # (0 disables; see ops/sampling.sample)
+    admit_per_tick: int = 4       # admission/prefill units per engine tick
+                                  # while decodes are running (burst TTFT vs
+                                  # decode-cadence trade; unbounded when the
+                                  # engine is idle)
 
 
 @dataclasses.dataclass
@@ -174,6 +178,23 @@ class Engine:
         dtype = jnp.dtype(self.ec.dtype) if self.ec.dtype else cfg.jdtype
         self.mesh = self.ec.mesh
 
+        if (jax.default_backend() == "tpu" and self.mesh is None
+                and os.environ.get("LOCALAI_NO_PALLAS") != "1"
+                and os.environ.get("LOCALAI_FORCE_PALLAS") != "1"):
+            # decide the attention tier NOW, eagerly — the in-trace probe
+            # path exists as a fallback but a load-time probe gives a clean
+            # log line and never races a jit trace. Prefill always asks for
+            # the kv_quant=False key (llama._attn_impls default), so warm
+            # both variants when the KV cache is quantized.
+            from localai_tpu.ops.kvcache import is_quant_kind
+            from localai_tpu.ops.pallas import pallas_works
+
+            pallas_works(cfg.num_heads, cfg.num_kv_heads, cfg.head_dim,
+                         cfg.sliding_window, cfg.jdtype, kv_quant=False)
+            if is_quant_kind(self.ec.cache_type):
+                pallas_works(cfg.num_heads, cfg.num_kv_heads, cfg.head_dim,
+                             cfg.sliding_window, cfg.jdtype, kv_quant=True)
+
         with activate_mesh(self.mesh):
             cos, sin = rope_table(cfg.rope, T)
             self._cos, self._sin = cos, sin
@@ -256,11 +277,21 @@ class Engine:
         cfg = self.cfg
 
         def _install_row(sampler, slot, row, counts_row):
+            # "light" rows (no penalties, no bias — the common case) omit the
+            # [V]-sized logit_bias and counts_row so an admission ships a few
+            # scalars instead of ~1 MB over a (possibly tunneled) link;
+            # absent fields are zeroed on device. None/missing keys are
+            # static → each variant compiles once.
             new_fields = {}
             for f in dataclasses.fields(SamplerState):
                 cur = getattr(sampler, f.name)
                 if f.name == "token_counts":
-                    new_fields[f.name] = cur.at[slot].set(counts_row)
+                    if counts_row is None:
+                        new_fields[f.name] = cur.at[slot].set(0)
+                    else:
+                        new_fields[f.name] = cur.at[slot].set(counts_row)
+                elif f.name == "logit_bias" and "logit_bias" not in row:
+                    new_fields[f.name] = cur.at[slot].set(0.0)
                 else:
                     new_fields[f.name] = cur.at[slot].set(row[f.name])
             return SamplerState(**new_fields)
@@ -367,19 +398,23 @@ class Engine:
             donate_argnums=(3, 4, 5, 6, 7))
 
         def _decode_block(params, cos, sin, kc, vc, sampler, last_logits,
-                          lengths, active, *, steps: int, fast_width=None):
+                          lengths, active, mask_bits=None, *, steps: int,
+                          fast_width=None):
             """`steps` fused sample→decode iterations in ONE device program.
 
             One dispatch + one result fetch per `steps` tokens: on a remote
             (tunneled) TPU the per-call host↔device round trip is tens of ms —
             more than the decode step itself — so fusing the loop is worth
-            ~steps× decode throughput. Grammar masks can't ride here (the PDA
-            must advance per token); the loop falls back to single steps."""
+            ~steps× decode throughput. Grammar slots ride the block with
+            their block-START mask held fixed; the host verifies each sampled
+            token against the PDA afterwards and rolls the slot back at the
+            first stale-mask miss (engine._repair) — free slots keep full
+            block speed either way."""
             def body(carry, _):
                 kc, vc, sampler, last_logits, lengths = carry
                 tokens, logprobs, kc, vc, sampler, last_logits, lengths = (
                     _decode(params, cos, sin, kc, vc, sampler, last_logits,
-                            lengths, active, None, fast_width))
+                            lengths, active, mask_bits, fast_width))
                 return (kc, vc, sampler, last_logits, lengths), (tokens,
                                                                  logprobs)
             carry = (kc, vc, sampler, last_logits, lengths)
@@ -388,6 +423,10 @@ class Engine:
             return toks, lps, kc, vc, sampler, last_logits, lengths
 
         self._decode_block_fn = jax.jit(
+            partial(_decode_block, mask_bits=None),
+            donate_argnums=(3, 4, 5, 6, 7),
+            static_argnames=("steps", "fast_width"))
+        self._decode_block_mask_fn = jax.jit(
             _decode_block, donate_argnums=(3, 4, 5, 6, 7),
             static_argnames=("steps", "fast_width"))
 
@@ -417,7 +456,7 @@ class Engine:
                 self._lengths,
                 jnp.asarray(ids), jnp.int32(n), jnp.int32(slot),
                 {k: jnp.asarray(v) for k, v in row.items()},
-                jnp.asarray(counts_row),
+                None if counts_row is None else jnp.asarray(counts_row),
             )
 
     def _dev_extend_mid(self, buf, pos, idx):
@@ -439,7 +478,7 @@ class Engine:
                 self._lengths, jnp.asarray(buf), jnp.int32(pos),
                 jnp.int32(nvalid), jnp.int32(idx),
                 {k: jnp.asarray(v) for k, v in row.items()},
-                jnp.asarray(counts_row))
+                None if counts_row is None else jnp.asarray(counts_row))
 
     def _dev_decode(self, active, mask_host=None, fast_width=None):
         self._bcast("decode", active=active,
@@ -463,16 +502,24 @@ class Engine:
                     *args)
         return tokens, logprobs
 
-    def _dev_decode_block(self, active, steps: int, fast_width=None):
+    def _dev_decode_block(self, active, steps: int, fast_width=None,
+                          mask_host=None):
         self._bcast("decode_block", active=active, steps=steps,
-                    fast_width=fast_width)
+                    fast_width=fast_width,
+                    mask=None if mask_host is None else mask_host)
         with activate_mesh(self.mesh):
-            (tokens, logprobs, self._kc, self._vc, self._sampler,
-             self._last_logits, self._lengths) = self._decode_block_fn(
-                self.params, self._cos, self._sin,
-                self._kc, self._vc, self._sampler, self._last_logits,
-                self._lengths, jnp.asarray(active), steps=steps,
-                fast_width=fast_width)
+            args = (self.params, self._cos, self._sin,
+                    self._kc, self._vc, self._sampler, self._last_logits,
+                    self._lengths, jnp.asarray(active))
+            if mask_host is not None:
+                (tokens, logprobs, self._kc, self._vc, self._sampler,
+                 self._last_logits, self._lengths) = self._decode_block_mask_fn(
+                    *args, jnp.asarray(mask_host), steps=steps,
+                    fast_width=None)
+            else:
+                (tokens, logprobs, self._kc, self._vc, self._sampler,
+                 self._last_logits, self._lengths) = self._decode_block_fn(
+                    *args, steps=steps, fast_width=fast_width)
         return tokens, logprobs
 
     def _dev_shift(self, idx):
@@ -530,7 +577,7 @@ class Engine:
                                  kw.get("fast_width"))
             elif op == "decode_block":
                 self._dev_decode_block(kw["active"], int(kw["steps"]),
-                                       kw.get("fast_width"))
+                                       kw.get("fast_width"), kw.get("mask"))
             elif op == "shift":
                 self._dev_shift(kw["idx"])
             elif op == "draft_ingest":
@@ -632,10 +679,21 @@ class Engine:
             chunked = True
             self.metrics["prompt_cache_hits"] += 1
             self.metrics["prompt_tokens_reused"] += lcp
-        counts_row = np.zeros((self.cfg.vocab_size,), np.int32)
-        pid, pcnt = np.unique(np.asarray(req.prompt_ids, np.int64), return_counts=True)
-        counts_row[pid] = pcnt
-        row = sampler_row(req.params, self.cfg.vocab_size, fallback_seed=rid + 1)
+        # token_counts/logit_bias only influence sampling when penalties or a
+        # bias are actually set — the common case skips both [V]-sized
+        # transfers (~1 MB/admission on a tunneled link)
+        p = req.params.normalized()
+        heavy = bool(p.logit_bias) or p.repeat_penalty != 1.0 \
+            or p.presence_penalty != 0.0 or p.frequency_penalty != 0.0
+        row = sampler_row(req.params, self.cfg.vocab_size,
+                          fallback_seed=rid + 1, include_bias=heavy)
+        if heavy:
+            counts_row = np.zeros((self.cfg.vocab_size,), np.int32)
+            pid, pcnt = np.unique(np.asarray(req.prompt_ids, np.int64),
+                                  return_counts=True)
+            counts_row[pid] = pcnt
+        else:
+            counts_row = None
 
         if not chunked:
             ids = np.zeros((1, bucket), np.int32)
@@ -673,56 +731,68 @@ class Engine:
         return True
 
     def _prefill_tick(self):
-        """One unit of admission work per engine tick: either continue the
-        oldest in-progress chunked prefill by ONE chunk, or admit one queued
-        request. Bounding this to one chunk keeps running decodes at a steady
-        cadence instead of stalling behind whole long prompts (the reference's
-        update_slots interleaving, grpc-server.cpp:69-97)."""
-        if self._prefillq:
-            idx = self._prefillq[0]
-            slot = self._slots[idx]
-            ids = slot.req.prompt_ids
-            pos = slot.prefill_pos
-            nvalid = min(len(ids) - pos, self._chunk)
-            buf = np.zeros((1, self._chunk), np.int32)
-            buf[0, :nvalid] = ids[pos:pos + nvalid]
-            final = pos + nvalid == len(ids)
-            if final:
-                self._dev_extend_final(buf, pos, nvalid, idx, slot.row,
-                                       slot.counts_row)
-            else:
-                self._dev_extend_mid(buf, pos, idx)
-            if self._draft is not None:
-                self._dev_draft_ingest(buf, pos, idx)
-            slot.prefill_pos = pos + nvalid
-            if final:
-                slot.prefilled = True
-                self._prefillq.pop(0)
+        """Admission work for one engine tick: continue in-progress chunked
+        prefills (oldest first) and admit queued requests, up to
+        `admit_per_tick` units while decodes are running — bounding the work
+        keeps running decodes at a steady cadence instead of stalling behind
+        whole long prompts (the reference's update_slots interleaving,
+        grpc-server.cpp:69-97). An idle engine (nothing decoding) has no
+        cadence to protect, so it drains freely — burst TTFT at high slot
+        counts is set by this path."""
+        budget = max(1, self.ec.admit_per_tick)
+        if not any(s is not None and s.prefilled for s in self._slots):
+            budget = max(budget, self.ec.max_slots)
+        for _ in range(budget):
+            if self._prefillq:
+                idx = self._prefillq[0]
+                slot = self._slots[idx]
+                ids = slot.req.prompt_ids
+                pos = slot.prefill_pos
+                nvalid = min(len(ids) - pos, self._chunk)
+                buf = np.zeros((1, self._chunk), np.int32)
+                buf[0, :nvalid] = ids[pos:pos + nvalid]
+                final = pos + nvalid == len(ids)
+                if final:
+                    self._dev_extend_final(buf, pos, nvalid, idx, slot.row,
+                                           slot.counts_row)
+                else:
+                    self._dev_extend_mid(buf, pos, idx)
                 if self._draft is not None:
-                    tok, lp = self._dev_spec_admit_tail(idx)
-                    self._emit(idx, slot, tok, lp, time.monotonic())
-            return
-        if not self._free:
-            return
-        try:
-            rid, req, out = self._queue.get_nowait()
-        except queue.Empty:
-            return
-        self._admit_one(rid, req, out)
+                    self._dev_draft_ingest(buf, pos, idx)
+                slot.prefill_pos = pos + nvalid
+                if final:
+                    slot.prefilled = True
+                    self._prefillq.pop(0)
+                    if self._draft is not None:
+                        tok, lp = self._dev_spec_admit_tail(idx)
+                        self._emit(idx, slot, tok, lp, time.monotonic())
+                continue
+            if not self._free:
+                return
+            try:
+                rid, req, out = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            self._admit_one(rid, req, out)
 
     def _active_mask(self) -> np.ndarray:
         return np.array([s is not None and s.prefilled for s in self._slots],
                         bool)
 
     def _block_steps(self) -> int:
-        """How many decode steps the next dispatch may fuse. 1 whenever any
-        per-token host decision is live: grammar masks, pending admissions or
-        chunked prefills (so new requests don't wait a whole block), a slot
-        near its context limit / shift boundary, or a slot that would finish
-        well inside the block (don't burn steps past max_tokens)."""
+        """How many decode steps the next dispatch may fuse. 1 whenever a
+        per-token host decision is live: pending admissions or chunked
+        prefills (so new requests don't wait a whole block), a slot near its
+        context limit / shift boundary, or a slot that would finish well
+        inside the block (don't burn steps past max_tokens). Grammar slots DO
+        ride blocks — sampled under their block-start mask, host-verified
+        against the PDA, rolled back at the first stale-mask miss — so one
+        constrained request no longer serializes every other tenant."""
         G = self.ec.decode_block
-        if (G <= 1 or self._grammar_slots > 0 or not self.ec.pipeline
-                or self._prefillq or not self._queue.empty()):
+        if (G <= 1 or not self.ec.pipeline or self._prefillq
+                or (self._free and not self._queue.empty())):
+            # a non-empty queue only matters if a slot is free to admit into —
+            # a saturated engine keeps full block fusion
             return 1
         limit = self.ec.max_context - 2 - self._ctx_reserve
         for s in self._slots:
@@ -753,31 +823,85 @@ class Engine:
             and all(self._slots[i] is not None and self._slots[i].fast_ok
                     for i, _ in entries)) else None
         steps = self._block_steps()
+        # snapshot the dispatch-time masks: _consume compares each slot's
+        # refreshed mask against what the device sampled under, to catch the
+        # allowed-set GROWING mid-block (see _consume)
+        gmask = self._mask_host.copy() if self._grammar_slots > 0 else None
         if steps > 1:
-            tokens, logprobs = self._dev_decode_block(active, steps, fast)
+            tokens, logprobs = self._dev_decode_block(active, steps, fast,
+                                                      gmask)
         else:
-            tokens, logprobs = self._dev_decode(
-                active, self._mask_host if self._grammar_slots > 0 else None,
-                fast)
-        return tokens, logprobs, entries
+            tokens, logprobs = self._dev_decode(active, gmask, fast)
+        return tokens, logprobs, entries, gmask
 
     def _consume(self, pend):
         """Block on a dispatched step's results and run the host-side token
         handling for every slot that was active at dispatch time and is still
-        serving the same request."""
-        tokens, logprobs, entries = pend
+        serving the same request. Grammar slots in a fused block sampled under
+        their block-START mask: the first token a slot's (live) PDA rejects
+        marks that slot for rollback — its accepted prefix stands, the rest of
+        its block is discarded, and _repair restores the device state."""
+        tokens, logprobs, entries, gmask = pend
         tokens = np.asarray(jax.device_get(tokens))
         logprobs = np.asarray(jax.device_get(logprobs))
         now = time.monotonic()
         if tokens.ndim == 1:
             tokens, logprobs = tokens[None], logprobs[None]
-        for g in range(tokens.shape[0]):
+        steps = tokens.shape[0]
+        rolled: list[int] = []
+        for g in range(steps):
             for i, rid in entries:
                 slot = self._slots[i]
-                if slot is None or slot.request_id != rid:
+                if slot is None or slot.request_id != rid or i in rolled:
                     continue  # finished earlier in this block (EOS/stop/len)
-                self._emit(i, slot, int(tokens[g, i]), float(logprobs[g, i]),
-                           now)
+                if not self._emit(i, slot, int(tokens[g, i]),
+                                  float(logprobs[g, i]), now,
+                                  fresh_mask=(g == 0)):
+                    rolled.append(i)
+                    continue
+                # mask-growth check: PDA-reject rollback makes in-block
+                # grammar sampling exact REJECTION sampling while the
+                # allowed set only shrinks — but if this token's acceptance
+                # OPENED tokens the dispatch mask forbade, the rest of the
+                # block was drawn from a wrongly-restricted distribution
+                # and must be discarded even though the PDA might accept it.
+                if (gmask is not None and g + 1 < steps
+                        and self._slots[i] is slot
+                        and slot.matcher is not None
+                        and np.any(self._mask_host[i] & ~gmask[i])):
+                    rolled.append(i)
+        for i in rolled:
+            slot = self._slots[i]
+            if slot is not None:
+                self._repair(i, slot)
+
+    def _repair(self, idx: int, slot: _Slot):
+        """Roll a grammar slot back to its last PDA-accepted token after a
+        fused block sampled past a stale mask (see _consume): re-run the model
+        on that token through the extend path — rewriting the same KV row with
+        identical values, restoring last_logits and lengths[slot] to the
+        accepted position — and re-install the sampler row with a fresh
+        deterministic RNG key (re-using the admission key would replay the
+        block's draws). The rows the block wrote past the accepted position
+        are garbage but unreadable: attention masks by lengths, and future
+        decode steps overwrite them in order."""
+        self.metrics["grammar_rollbacks"] = (
+            self.metrics.get("grammar_rollbacks", 0) + 1)
+        n = slot.prompt_len + slot.generated - slot.shifted  # valid rows
+        seq = list(slot.req.prompt_ids) + slot.gen_ids
+        buf = np.zeros((1, self._chunk), np.int32)
+        buf[0, 0] = seq[-1]
+        seed = (slot.request_id * 1000003 + slot.generated) & 0x7FFFFFFF
+        key = np.asarray(jax.random.key_data(
+            jax.random.PRNGKey(seed))).astype(np.uint32)
+        row = dict(slot.row, key=key)
+        slot.row = row
+        counts = slot.counts_row
+        if counts is not None:
+            counts = counts.copy()
+            for t in slot.gen_ids:
+                counts[t] += 1
+        self._dev_extend_final(buf, n - 1, 1, idx, row, counts)
 
     def _step_spec(self) -> bool:
         """Spec-mode iteration: one batched draft+verify step for all active
@@ -838,20 +962,21 @@ class Engine:
                 or not self._queue.empty() or self._pending is not None)
 
     def _emit(self, idx: int, slot: _Slot, token_id: int, logprob: float,
-              now: float):
-        if slot.first_token_time is None:
-            slot.first_token_time = now
-            self.metrics["ttft_ms_last"] = (now - slot.start_time) * 1e3
-        slot.generated += 1
-        slot.gen_ids.append(token_id)
-        self.metrics["tokens_generated"] += 1
-
+              now: float, fresh_mask: bool = True) -> bool:
+        """Commit one sampled token to `slot` (grammar advance, detok, stop
+        scan, stream, maybe finish). Returns False — with NO state mutated —
+        when the slot's grammar rejects a token sampled under a STALE fused-
+        block mask (fresh_mask=False); the caller then rolls the device back
+        (_repair). A rejection under a FRESH mask means mask and matcher
+        disagree (should not happen): finish the request defensively instead
+        of livelocking on an identical resample."""
         finish = None
-        cache_len = slot.prompt_len + slot.generated - slot.shifted
-        if (not slot.req.ignore_eos and self.tok is not None
-                and token_id in self.tok.eos_ids):
+        shift = False
+        cache_len = slot.prompt_len + slot.generated + 1 - slot.shifted
+        is_eos = self.tok is not None and token_id in self.tok.eos_ids
+        if is_eos and not slot.req.ignore_eos:
             finish = "eos"
-        elif slot.generated >= slot.req.max_tokens:
+        elif slot.generated + 1 >= slot.req.max_tokens:
             finish = "length"
         elif cache_len >= self.ec.max_context - 2 - self._ctx_reserve:
             if slot.req.context_shift:
@@ -859,21 +984,47 @@ class Engine:
                 # left, re-rotating K; the in-flight pipelined step wrote at a
                 # pre-shift position and is already part of the device state
                 # (spec mode rejected context_shift at submit)
-                self._dev_shift(idx)
-                slot.shifted += self._shift_discard
+                shift = True
             else:
                 finish = "length"
 
-        # grammar: advance the PDA with the sampled token, refresh the mask
-        if slot.matcher is not None and finish is None:
+        # grammar: validate + advance the PDA BEFORE mutating anything, so a
+        # stale-mask rejection leaves the slot exactly at its accepted prefix
+        if slot.matcher is not None:
             eos = self.tok.eos_ids if self.tok else ()
-            if slot.matcher.accept(token_id):
-                self._mask_host[idx] = slot.matcher.mask_bits(eos)
-                if (slot.matcher.done and not slot.matcher.can_continue
-                        and not eos):
-                    finish = "stop"  # complete and nothing can follow
-            else:
-                finish = "stop"  # defensive: mask should prevent this
+            if is_eos:
+                # EOS never advances the PDA; it is legal exactly when the
+                # grammar is complete (mask_bits sets the EOS bits then). A
+                # stale block mask can propose EOS mid-grammar — roll back.
+                if not slot.matcher.done:
+                    if not fresh_mask:
+                        return False
+                    if finish is None:
+                        finish = "stop"  # mask/matcher disagreement
+                elif finish is None:
+                    # ignore_eos + completed grammar: the model stopped and
+                    # rolling back would just re-sample the same EOS forever
+                    finish = "stop"
+            elif finish is None:
+                if slot.matcher.accept(token_id):
+                    self._mask_host[idx] = slot.matcher.mask_bits(eos)
+                    if (slot.matcher.done and not slot.matcher.can_continue
+                            and not eos):
+                        finish = "stop"  # complete and nothing can follow
+                elif not fresh_mask:
+                    return False
+                else:
+                    finish = "stop"  # mask/matcher disagreement (defensive)
+
+        if slot.first_token_time is None:
+            slot.first_token_time = now
+            self.metrics["ttft_ms_last"] = (now - slot.start_time) * 1e3
+        slot.generated += 1
+        slot.gen_ids.append(token_id)
+        self.metrics["tokens_generated"] += 1
+        if shift:
+            self._dev_shift(idx)
+            slot.shifted += self._shift_discard
 
         text = ""
         if slot.detok is not None:
@@ -915,6 +1066,7 @@ class Engine:
                 self.metrics["tokens_per_second_last"] = slot.generated / dur
             self.metrics["requests_completed"] += 1
             self._release_slot(idx, slot)
+        return True
 
     def _pick_slot(self, prompt_ids: list[int]) -> tuple[int, int]:
         """Choose a free slot, preferring one whose cached tokens share the

@@ -21,8 +21,10 @@ _SPACE = 'space ::= " "?'
 _PRIMITIVES = {
     "boolean": 'boolean ::= ("true" | "false") space',
     "null": 'null ::= "null" space',
+    # raw control chars < 0x20 are NOT legal inside a JSON string — they
+    # must ride the escape branch (RFC 8259; json.loads rejects them)
     "string": r'''string ::= "\"" (
-  [^"\\] |
+  [^"\\\x00-\x1f] |
   "\\" (["\\/bfnrt] | "u" [0-9a-fA-F] [0-9a-fA-F] [0-9a-fA-F] [0-9a-fA-F])
 )* "\"" space''',
     "number": 'number ::= ("-"? ([0-9] | [1-9] [0-9]*)) ("." [0-9]+)? '

@@ -393,7 +393,8 @@ def pallas_works(num_heads: int = 4, num_kv_heads: int = 2,
     if jax.default_backend() != "tpu":
         _PROBE_CACHE[key] = True        # interpreter mode: always lowers
         return True
-    try:
+
+    def _probe():
         B, S, T = 1, 256, 512
         q = jnp.zeros((B, S, num_heads, head_dim), dtype)
         kv = jnp.zeros((B, S, num_kv_heads, head_dim), dtype)
@@ -404,20 +405,42 @@ def pallas_works(num_heads: int = 4, num_kv_heads: int = 2,
         if kv_quant:
             cq = jnp.zeros((B, num_kv_heads, T, head_dim), jnp.int8)
             cs = jnp.zeros((B, num_kv_heads, T // 128, 128), jnp.float32)
-            ragged_decode_q8(qd, cq, cs, cq, cs, lengths,
-                             sliding_window=sliding_window).block_until_ready()
+            ragged_decode_q8(
+                qd, cq, cs, cq, cs, lengths,
+                sliding_window=sliding_window).block_until_ready()
         else:
             cache = jnp.zeros((B, num_kv_heads, T, head_dim), dtype)
             ragged_decode(qd, cache, cache, lengths,
                           sliding_window=sliding_window).block_until_ready()
-        ok = True
-    except Exception as e:      # pragma: no cover - TPU-only branch
+
+    # _attn_impls consults this probe at TRACE time (inside jit). JAX's trace
+    # stack is thread-local, so a worker thread compiles + runs the probe
+    # eagerly even mid-trace — jnp.zeros above must produce real arrays, not
+    # tracers (round-4 bench silently fell back to XLA attention exactly
+    # here), and pallas_call cannot run under ensure_compile_time_eval.
+    import threading
+
+    box: dict = {}
+
+    def _runner():
+        try:
+            _probe()
+            box["ok"] = True
+        except Exception as e:          # pragma: no cover - TPU-only branch
+            box["ok"] = False
+            box["err"] = e
+
+    t = threading.Thread(target=_runner, daemon=True)
+    t.start()
+    t.join()
+    ok = box.get("ok", False)
+    if not ok:
         import logging
 
         logging.getLogger("localai_tpu").warning(
             "Pallas attention failed to lower on %s for heads=%d kv=%d d=%d "
             "— falling back to XLA attention: %s",
-            jax.devices()[0].device_kind, num_heads, num_kv_heads, head_dim, e)
-        ok = False
+            jax.devices()[0].device_kind, num_heads, num_kv_heads, head_dim,
+            box.get("err"))
     _PROBE_CACHE[key] = ok
     return ok

@@ -87,20 +87,25 @@ class SamplerState:
         )
 
 
-def sampler_row(params: SamplingParams, vocab: int, fallback_seed: int) -> dict:
+def sampler_row(params: SamplingParams, vocab: int, fallback_seed: int,
+                include_bias: bool = True) -> dict:
     """Host-side: build the per-slot row values (everything except
     token_counts, which the engine fills with prompt occurrence counts).
-    `fallback_seed` is used when the request doesn't pin a seed."""
+    `fallback_seed` is used when the request doesn't pin a seed.
+    include_bias=False omits the [V]-sized logit_bias entirely (the engine's
+    light-row path — building it here would already device-transfer it)."""
     import numpy as np
 
     p = params.normalized()
-    bias = np.zeros((vocab,), np.float32)
-    if p.logit_bias:
-        for k, v in p.logit_bias.items():
-            if 0 <= int(k) < vocab:
-                bias[int(k)] = v
+    bias = None
+    if include_bias:
+        bias = np.zeros((vocab,), np.float32)
+        if p.logit_bias:
+            for k, v in p.logit_bias.items():
+                if 0 <= int(k) < vocab:
+                    bias[int(k)] = v
     seed = p.seed if (p.seed is not None and p.seed >= 0) else fallback_seed
-    return dict(
+    row = dict(
         temperature=jnp.float32(p.temperature),
         top_k=jnp.int32(min(p.top_k, vocab)),
         top_p=jnp.float32(p.top_p),
@@ -111,8 +116,10 @@ def sampler_row(params: SamplingParams, vocab: int, fallback_seed: int) -> dict:
         frequency_penalty=jnp.float32(p.frequency_penalty),
         greedy=jnp.bool_(p.greedy),
         key=jax.random.key_data(jax.random.PRNGKey(seed)).astype(jnp.uint32),
-        logit_bias=jnp.asarray(bias),
     )
+    if bias is not None:
+        row["logit_bias"] = jnp.asarray(bias)
+    return row
 
 
 def apply_penalties(logits, state: SamplerState):
