@@ -12,10 +12,14 @@ from localai_tpu.backend.base import BackendServicer
 
 
 class _LatentWrapper:
-    """LatentDiffusion → the DiffusionModel file-output surface."""
+    """LatentDiffusion → the DiffusionModel file-output surface. With a
+    motion adapter (`video` = models/video_diffusion.VideoDiffusion) video
+    requests run the TEMPORAL pipeline — frames denoise jointly under the
+    motion modules — instead of the per-frame fallback."""
 
-    def __init__(self, pipe):
+    def __init__(self, pipe, video=None):
         self.pipe = pipe
+        self.video = video
 
     def generate_image(self, prompt, dst, *, negative_prompt="", width=512,
                        height=512, steps=20, seed=0):
@@ -31,12 +35,17 @@ class _LatentWrapper:
                        width=128, height=128, steps=8, seed=0):
         from PIL import Image
 
-        cond, uncond = self.pipe.encode_prompts(prompt)  # once, not per frame
-        frames = []
-        for f in range(num_frames):
-            arr = self.pipe.sample(cond, uncond, width=width, height=height,
-                                   steps=steps, seed=seed + f)
-            frames.append(Image.fromarray(arr))
+        if self.video is not None:
+            arr = self.video.txt2video(prompt, width=width, height=height,
+                                       num_frames=num_frames, steps=steps,
+                                       seed=seed)
+            frames = [Image.fromarray(f) for f in arr]
+        else:
+            # no motion adapter: per-frame sampling (last-resort fallback)
+            cond, uncond = self.pipe.encode_prompts(prompt)
+            frames = [Image.fromarray(self.pipe.sample(
+                cond, uncond, width=width, height=height, steps=steps,
+                seed=seed + f)) for f in range(num_frames)]
         frames[0].save(dst, save_all=True, append_images=frames[1:],
                        duration=int(1000 / fps), loop=0)
         return dst
@@ -62,13 +71,23 @@ class ImageServicer(BackendServicer):
 
                 try:
                     if model_dir and is_diffusers_checkpoint(model_dir):
-                        # real SD-class checkpoint (diffusers layout)
+                        # real SD-class checkpoint (diffusers layout); a
+                        # motion_adapter/ subdir upgrades video to the
+                        # temporal AnimateDiff-style pipeline
                         from localai_tpu.models.latent_diffusion import (
                             LatentDiffusion,
                         )
+                        from localai_tpu.models.video_diffusion import (
+                            VideoDiffusion, is_video_checkpoint,
+                        )
 
-                        self.model = _LatentWrapper(LatentDiffusion(
-                            model_dir, dtype=request.dtype or "float32"))
+                        if is_video_checkpoint(model_dir):
+                            vid = VideoDiffusion(
+                                model_dir, dtype=request.dtype or "float32")
+                            self.model = _LatentWrapper(vid.base, vid)
+                        else:
+                            self.model = _LatentWrapper(LatentDiffusion(
+                                model_dir, dtype=request.dtype or "float32"))
                     elif model_dir and os.path.isdir(model_dir):
                         # an explicit checkpoint that is NOT a diffusers
                         # layout must fail loudly, never silently produce

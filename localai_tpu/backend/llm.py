@@ -63,8 +63,14 @@ class LLMServicer(BackendServicer):
         from localai_tpu.parallel.mesh import MeshConfig, build_mesh
 
         model_dir = request.model
-        if request.model_path and not os.path.isdir(model_dir):
+        if request.model_path and not os.path.exists(model_dir):
             model_dir = os.path.join(request.model_path, request.model)
+        if os.path.isfile(model_dir) and model_dir.endswith(".gguf"):
+            # GGUF ingestion (reference: llama.cpp serves GGUF natively;
+            # here it converts once to the HF layout — services/gguf.py)
+            from localai_tpu.services.gguf import resolve_gguf
+
+            model_dir = resolve_gguf(model_dir)
         if not os.path.isdir(model_dir):
             raise FileNotFoundError(f"model directory not found: {model_dir}")
 
@@ -125,7 +131,7 @@ class LLMServicer(BackendServicer):
                        context=context_size,
                        dtype=request.dtype or cfg.dtype,
                        cache_type=kv_kind, draft_cfg=dcfg, shards=shards,
-                       kv_shards=kv_shards)
+                       kv_shards=kv_shards, kv_pages=request.kv_pages)
         if est.fits is False:
             import logging
 
@@ -161,6 +167,7 @@ class LLMServicer(BackendServicer):
             mesh=mesh,
             gamma=request.n_draft or 4,
             cache_type=cache_type,
+            kv_pages=request.kv_pages,
         ), draft=draft)
         if request.embeddings:
             from localai_tpu.engine.embedder import CrossScorer

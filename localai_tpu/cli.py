@@ -49,6 +49,9 @@ def _add_federated(sub):
     p = sub.add_parser("federated",
                        help="run a federated load balancer over workers")
     p.add_argument("--address", default="127.0.0.1:9090")
+    p.add_argument("--token", default="",
+                   help="shared federation token (HMAC-signed requests; "
+                        "default $LOCALAI_FEDERATION_TOKEN)")
     p.add_argument("--workers", default="",
                    help="comma-separated worker base URLs")
     p.add_argument("--strategy", default="least_used",

@@ -18,6 +18,9 @@ class AppConfig:
     context_size: int = 0
     parallel_requests: int = 4       # default engine slots per model
     api_keys: list[str] = dataclasses.field(default_factory=list)
+    federation_token: str = ""       # shared-token HMAC (federation/auth.py);
+                                     # a valid X-LocalAI-Federation signature
+                                     # authorizes like an API key
     cors: bool = False
     single_active_backend: bool = False
     watchdog_idle_timeout: float = 0.0   # seconds; 0 = disabled
@@ -43,6 +46,9 @@ class AppConfig:
         keys = env("API_KEY", str)
         if keys:
             cfg.api_keys = [k.strip() for k in keys.split(",") if k.strip()]
+        tok = env("FEDERATION_TOKEN", str)
+        if tok:
+            cfg.federation_token = tok
         for k, v in overrides.items():
             if v is not None and hasattr(cfg, k):
                 setattr(cfg, k, v)

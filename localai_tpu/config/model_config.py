@@ -90,6 +90,8 @@ class ModelConfig:
     n_draft: int = 0                 # draft tokens per step (0 = default 4)
     cache_type_k: str = ""           # KV cache storage: ""|bf16|int8|q8_0
     cache_type_v: str = ""           # (reference cache_type_k/v YAML keys)
+    kv_pages: int = 0                # paged KV pool size in 128-token blocks
+                                     # (0 = dense per-slot cache)
     mcp: dict = dataclasses.field(default_factory=dict)
                                      # MCP servers {servers: [...], stdio:
                                      # [...]} (reference config.MCP block)

@@ -142,6 +142,7 @@ class ModelManager:
             n_draft=m.n_draft,
             cache_type_key=m.cache_type_k,
             cache_type_value=m.cache_type_v,
+            kv_pages=m.kv_pages,
         )
         if not r.success:
             raise RuntimeError(f"LoadModel({m.name}) failed: {r.message}")

@@ -89,6 +89,12 @@ class EngineConfig:
                                   # while decodes are running (burst TTFT vs
                                   # decode-cadence trade; unbounded when the
                                   # engine is idle)
+    kv_pages: int = 0             # paged KV: physical 128-token blocks in the
+                                  # shared pool, incl. the reserved trash
+                                  # block 0 (0 = dense per-slot cache). Slots
+                                  # reserve ceil((prompt+max_tokens)/128)
+                                  # blocks at admission, so the pool
+                                  # oversubscribes max_context, not requests.
 
 
 @dataclasses.dataclass
@@ -195,11 +201,40 @@ class Engine:
                 pallas_works(cfg.num_heads, cfg.num_kv_heads, cfg.head_dim,
                              cfg.sliding_window, cfg.jdtype, kv_quant=True)
 
+        # paged KV (ops/paged.py): block pool + per-slot tables instead of a
+        # dense [B, T] product. Host owns allocation; the device sees a
+        # [B, MAXB] table per dispatch. Incompatible (v1) with meshes,
+        # speculative drafts, context-shift and the disk prompt cache.
+        self._paged = self.ec.kv_pages > 0
+        if self._paged:
+            from localai_tpu.ops.paged import BLOCK
+
+            if self.mesh is not None:
+                raise NotImplementedError("paged KV under a mesh")
+            if draft is not None:
+                raise NotImplementedError("paged KV with a draft model")
+            if self.ec.kv_pages < 2:
+                raise ValueError("kv_pages must be >= 2 (block 0 is trash)")
+            self._maxb = -(-T // BLOCK)
+            self._table = np.zeros((B, self._maxb), np.int32)
+            self._kv_free: list[int] = list(range(1, self.ec.kv_pages))
+            self._slot_blocks: list[list[int]] = [[] for _ in range(B)]
+            self._released_lru: list[int] = []
+        self._deferred: tuple | None = None   # admission waiting on blocks
+        self._blocks_freed = False
+
         with activate_mesh(self.mesh):
             cos, sin = rope_table(cfg.rope, T)
             self._cos, self._sin = cos, sin
-            self._kc, self._vc = init_kv_cache(cfg, B, T, dtype,
-                                               cache_type=self.ec.cache_type)
+            if self._paged:
+                from localai_tpu.ops.paged import init_paged
+
+                self._kc, self._vc = init_paged(
+                    cfg.num_layers, self.ec.kv_pages, cfg.num_kv_heads,
+                    cfg.head_dim, dtype, cache_type=self.ec.cache_type)
+            else:
+                self._kc, self._vc = init_kv_cache(
+                    cfg, B, T, dtype, cache_type=self.ec.cache_type)
             self._sampler = SamplerState.init(B, V)
             self._last_logits = jnp.zeros((B, V), jnp.float32)
             self._lengths = jnp.zeros((B,), jnp.int32)
@@ -297,44 +332,47 @@ class Engine:
             return SamplerState(**new_fields)
 
         def _admit(params, cos, sin, kc, vc, sampler, last_logits, lengths,
-                   tokens, length, slot, row, counts_row):
+                   tokens, length, slot, row, counts_row, table=None):
             """Prefill one request into `slot` + install its sampler row."""
             logits, kc, vc = prefill(
-                params, cfg, tokens, length[None], cos, sin, kc, vc, slot[None]
+                params, cfg, tokens, length[None], cos, sin, kc, vc,
+                slot[None], table
             )
             last_logits = last_logits.at[slot].set(logits[0])
             lengths = lengths.at[slot].set(length)
             sampler = _install_row(sampler, slot, row, counts_row)
             return kc, vc, sampler, last_logits, lengths
 
-        def _extend_mid(params, cos, sin, kc, vc, tokens, start, slot):
+        def _extend_mid(params, cos, sin, kc, vc, tokens, start, slot,
+                        table=None):
             """One non-final prefill chunk: KV writes only."""
             _, kc, vc = extend(params, cfg, tokens, start[None], cos, sin,
-                               kc, vc, slot_map=slot[None], with_logits=False)
+                               kc, vc, slot_map=slot[None], with_logits=False,
+                               table=table)
             return kc, vc
 
         def _extend_final(params, cos, sin, kc, vc, sampler, last_logits,
                           lengths, tokens, start, nvalid, slot, row,
-                          counts_row):
+                          counts_row, table=None):
             """Final prefill chunk: KV writes + last-token logits + sampler
             row install (deferred to here so the request's RNG stream is
             independent of how many engine ticks the prefill spanned)."""
             logits, kc, vc = extend(
                 params, cfg, tokens, start[None], cos, sin, kc, vc,
                 slot_map=slot[None],
-                last_pos=jnp.maximum(nvalid - 1, 0)[None])
+                last_pos=jnp.maximum(nvalid - 1, 0)[None], table=table)
             last_logits = last_logits.at[slot].set(logits[0])
             lengths = lengths.at[slot].set(start + nvalid)
             sampler = _install_row(sampler, slot, row, counts_row)
             return kc, vc, sampler, last_logits, lengths
 
         def _decode(params, cos, sin, kc, vc, sampler, last_logits, lengths,
-                    active, mask_bits, fast_width=None):
+                    active, mask_bits, fast_width=None, table=None):
             """sample(prev logits) → decode → next logits, for all slots."""
             tokens, keys, logprobs = sample(last_logits, sampler, mask_bits,
                                             topk_width=fast_width)
             logits, kc, vc = decode_step(
-                params, cfg, tokens, lengths, cos, sin, kc, vc, active
+                params, cfg, tokens, lengths, cos, sin, kc, vc, active, table
             )
             act = active.astype(jnp.int32)
             counts = sampler.token_counts.at[
@@ -398,8 +436,8 @@ class Engine:
             donate_argnums=(3, 4, 5, 6, 7))
 
         def _decode_block(params, cos, sin, kc, vc, sampler, last_logits,
-                          lengths, active, mask_bits=None, *, steps: int,
-                          fast_width=None):
+                          lengths, active, mask_bits=None, table=None, *,
+                          steps: int, fast_width=None):
             """`steps` fused sample→decode iterations in ONE device program.
 
             One dispatch + one result fetch per `steps` tokens: on a remote
@@ -414,7 +452,7 @@ class Engine:
                 kc, vc, sampler, last_logits, lengths = carry
                 tokens, logprobs, kc, vc, sampler, last_logits, lengths = (
                     _decode(params, cos, sin, kc, vc, sampler, last_logits,
-                            lengths, active, mask_bits, fast_width))
+                            lengths, active, mask_bits, fast_width, table))
                 return (kc, vc, sampler, last_logits, lengths), (tokens,
                                                                  logprobs)
             carry = (kc, vc, sampler, last_logits, lengths)
@@ -444,6 +482,12 @@ class Engine:
                     v, (list, tuple)) else v)
                 for k, v in kw.items()})
 
+    def _tab(self):
+        """Device copy of the block table for this dispatch (paged KV only).
+        Tiny ([B, MAXB] i32) — shipping it per call keeps the host allocator
+        the single source of truth with no donation bookkeeping."""
+        return jnp.asarray(self._table) if self._paged else None
+
     def _dev_admit(self, ids, n, slot, row, counts_row):
         self._bcast("admit", ids=ids, n=n, slot=slot,
                     row={k: np.asarray(v) for k, v in row.items()},
@@ -457,6 +501,7 @@ class Engine:
                 jnp.asarray(ids), jnp.int32(n), jnp.int32(slot),
                 {k: jnp.asarray(v) for k, v in row.items()},
                 None if counts_row is None else jnp.asarray(counts_row),
+                self._tab(),
             )
 
     def _dev_extend_mid(self, buf, pos, idx):
@@ -464,7 +509,7 @@ class Engine:
         with activate_mesh(self.mesh):
             self._kc, self._vc = self._extend_mid_fn(
                 self.params, self._cos, self._sin, self._kc, self._vc,
-                jnp.asarray(buf), jnp.int32(pos), jnp.int32(idx))
+                jnp.asarray(buf), jnp.int32(pos), jnp.int32(idx), self._tab())
 
     def _dev_extend_final(self, buf, pos, nvalid, idx, row, counts_row):
         self._bcast("extend_final", buf=buf, pos=pos, nvalid=nvalid, idx=idx,
@@ -478,7 +523,8 @@ class Engine:
                 self._lengths, jnp.asarray(buf), jnp.int32(pos),
                 jnp.int32(nvalid), jnp.int32(idx),
                 {k: jnp.asarray(v) for k, v in row.items()},
-                None if counts_row is None else jnp.asarray(counts_row))
+                None if counts_row is None else jnp.asarray(counts_row),
+                self._tab())
 
     def _dev_decode(self, active, mask_host=None, fast_width=None):
         self._bcast("decode", active=active,
@@ -491,15 +537,15 @@ class Engine:
             if mask_host is not None:
                 (tokens, logprobs, self._kc, self._vc, self._sampler,
                  self._last_logits, self._lengths) = self._decode_fn(
-                    *args, jnp.asarray(mask_host))
+                    *args, jnp.asarray(mask_host), table=self._tab())
             elif fast_width:
                 (tokens, logprobs, self._kc, self._vc, self._sampler,
                  self._last_logits, self._lengths) = self._decode_fast_fn(
-                    *args)
+                    *args, table=self._tab())
             else:
                 (tokens, logprobs, self._kc, self._vc, self._sampler,
                  self._last_logits, self._lengths) = self._decode_nomask_fn(
-                    *args)
+                    *args, table=self._tab())
         return tokens, logprobs
 
     def _dev_decode_block(self, active, steps: int, fast_width=None,
@@ -514,12 +560,13 @@ class Engine:
             if mask_host is not None:
                 (tokens, logprobs, self._kc, self._vc, self._sampler,
                  self._last_logits, self._lengths) = self._decode_block_mask_fn(
-                    *args, jnp.asarray(mask_host), steps=steps,
-                    fast_width=None)
+                    *args, jnp.asarray(mask_host), table=self._tab(),
+                    steps=steps, fast_width=None)
             else:
                 (tokens, logprobs, self._kc, self._vc, self._sampler,
                  self._last_logits, self._lengths) = self._decode_block_fn(
-                    *args, steps=steps, fast_width=fast_width)
+                    *args, table=self._tab(), steps=steps,
+                    fast_width=fast_width)
         return tokens, logprobs
 
     def _dev_shift(self, idx):
@@ -610,6 +657,17 @@ class Engine:
             raise ValueError(
                 "context_shift is not supported with a draft model "
                 "(the draft cache would need shifting too)")
+        if req.context_shift and self._paged:
+            raise ValueError(
+                "context_shift is not supported with paged KV (cache_shift "
+                "rewrites dense per-slot regions); use a dense cache or a "
+                "larger max_context")
+        if self._paged and self._blocks_for(req) > self.ec.kv_pages - 1:
+            raise ValueError(
+                f"request needs {self._blocks_for(req)} KV blocks "
+                f"(prompt {len(req.prompt_ids)} + max_tokens "
+                f"{req.max_tokens}) but the pool has {self.ec.kv_pages - 1}; "
+                f"raise kv_pages or lower max_tokens")
         V = self.cfg.vocab_size
         if any(not (0 <= t < V) for t in req.prompt_ids):
             raise ValueError(f"prompt token id outside [0, {V})")
@@ -669,6 +727,12 @@ class Engine:
             ))
             return False
         slot, lcp = self._pick_slot(req.prompt_ids)
+        if self._paged and not self._alloc_slot(slot, req):
+            # pool exhausted even after reclaim: defer (FIFO) until blocks
+            # free — the caller re-attempts on later ticks
+            self._free.append(slot)
+            self._deferred = (rid, req, out)
+            return None
         self._slot_kv_tokens[slot] = []
         disk_prefix = 0
         if not lcp and req.prompt_cache_path:
@@ -769,11 +833,21 @@ class Engine:
                 continue
             if not self._free:
                 return
-            try:
-                rid, req, out = self._queue.get_nowait()
-            except queue.Empty:
+            if self._deferred is not None:
+                # a paged admission waiting on KV blocks retries only after
+                # something released (head-of-line, preserving FIFO)
+                if not self._blocks_freed:
+                    return
+                self._blocks_freed = False
+                rid, req, out = self._deferred
+                self._deferred = None
+            else:
+                try:
+                    rid, req, out = self._queue.get_nowait()
+                except queue.Empty:
+                    return
+            if self._admit_one(rid, req, out) is None:
                 return
-            self._admit_one(rid, req, out)
 
     def _active_mask(self) -> np.ndarray:
         return np.array([s is not None and s.prefilled for s in self._slots],
@@ -931,7 +1005,7 @@ class Engine:
         else:
             self._prefill_tick()
         return (any(s is not None for s in self._slots)
-                or not self._queue.empty())
+                or not self._queue.empty() or self._deferred is not None)
 
     def step(self) -> bool:
         """One engine iteration. In pipelined mode (the default, grammar-free)
@@ -959,7 +1033,8 @@ class Engine:
             if prev is not None:
                 self._consume(prev)
         return (any(s is not None for s in self._slots)
-                or not self._queue.empty() or self._pending is not None)
+                or not self._queue.empty() or self._pending is not None
+                or self._deferred is not None)
 
     def _emit(self, idx: int, slot: _Slot, token_id: int, logprob: float,
               now: float, fresh_mask: bool = True) -> bool:
@@ -1068,6 +1143,59 @@ class Engine:
             self._release_slot(idx, slot)
         return True
 
+    # --------------------------------------------- paged-KV block allocator
+    # Host-side, reservation-based: a request reserves every block it could
+    # ever write (prompt + max_tokens + in-flight margin) at admission, so
+    # generation can never exhaust the pool mid-flight — oversubscription
+    # comes from max_tokens being much smaller than max_context. Released
+    # slots RETAIN their blocks (the warm prefix cache) until the pool runs
+    # short, then the least-recently-released slot is reclaimed.
+
+    def _blocks_for(self, req: GenRequest) -> int:
+        from localai_tpu.ops.paged import blocks_needed
+
+        margin = 2 * self.ec.decode_block + 1   # in-flight pipelined writes
+        tokens = min(len(req.prompt_ids) + max(req.max_tokens, 0) + margin,
+                     self.ec.max_context)
+        return blocks_needed(tokens)
+
+    def _take_blocks(self, k: int, keep_slot: int):
+        """Pop k free blocks, reclaiming released slots' retained blocks
+        (oldest first, never `keep_slot` — its prefix is being reused).
+        Returns None when the pool genuinely cannot satisfy k."""
+        while len(self._kv_free) < k:
+            victim = next((s for s in self._released_lru if s != keep_slot),
+                          None)
+            if victim is None:
+                return None
+            self._released_lru.remove(victim)
+            self._kv_free.extend(self._slot_blocks[victim])
+            self._slot_blocks[victim] = []
+            self._slot_kv_tokens[victim] = []
+            self._table[victim, :] = 0
+        out = self._kv_free[:k]
+        del self._kv_free[:k]
+        return out
+
+    def _alloc_slot(self, slot: int, req: GenRequest) -> bool:
+        """Size `slot`'s block list for `req` (keeping any retained prefix
+        blocks); update the table row. False = pool exhausted (defer)."""
+        need = self._blocks_for(req)
+        have = self._slot_blocks[slot]
+        if len(have) < need:
+            got = self._take_blocks(need - len(have), keep_slot=slot)
+            if got is None:
+                return False
+            have.extend(got)
+        elif len(have) > need:
+            self._kv_free.extend(have[need:])
+            del have[need:]
+        self._table[slot, :] = 0
+        self._table[slot, :len(have)] = have
+        if slot in self._released_lru:
+            self._released_lru.remove(slot)
+        return True
+
     def _pick_slot(self, prompt_ids: list[int]) -> tuple[int, int]:
         """Choose a free slot, preferring one whose cached tokens share the
         longest prefix with the new prompt (llama.cpp's slot prompt cache).
@@ -1105,7 +1233,7 @@ class Engine:
     def _load_prompt_cache(self, slot: int, req: GenRequest) -> int:
         """Restore a saved KV prefix into `slot` if the file's tokens prefix
         this prompt. Returns the reusable length (0 = cold)."""
-        if self.mesh is not None or self._draft is not None:
+        if self.mesh is not None or self._draft is not None or self._paged:
             return 0
         try:
             with np.load(req.prompt_cache_path, allow_pickle=False) as z:
@@ -1151,7 +1279,7 @@ class Engine:
         cache file (skipped for RO requests, meshes, shifted slots)."""
         if (not slot.req.prompt_cache_path or slot.req.prompt_cache_ro
                 or self.mesh is not None or self._draft is not None
-                or slot.shifted or not slot.prefilled):
+                or self._paged or slot.shifted or not slot.prefilled):
             return
         n = min(slot.prompt_len, self.ec.max_context - 2)
         if slot.disk_prefix >= n - 1:
@@ -1194,6 +1322,30 @@ class Engine:
         if slot.matcher is not None:
             self._mask_host[idx] = 0xFF
             self._grammar_slots -= 1
+        if self._paged:
+            if self.ec.prompt_cache and slot.shifted == 0:
+                # retain ONLY the blocks holding cached rows as the warm
+                # prefix cache (reclaimable oldest-first, _take_blocks); the
+                # unused tail of the reservation returns to the pool now.
+                # Safe against the in-flight pipelined step: it writes
+                # through the table captured at ITS dispatch, and device
+                # ordering runs it before any later admission's prefill.
+                from localai_tpu.ops.paged import blocks_needed
+
+                kept = min(slot.prompt_len + slot.generated,
+                           self.ec.max_context - 2)
+                keep = blocks_needed(kept)
+                blocks = self._slot_blocks[idx]
+                if len(blocks) > keep:
+                    self._kv_free.extend(blocks[keep:])
+                    del blocks[keep:]
+                    self._table[idx, keep:] = 0
+                self._released_lru.append(idx)
+            else:
+                self._kv_free.extend(self._slot_blocks[idx])
+                self._slot_blocks[idx] = []
+                self._table[idx, :] = 0
+            self._blocks_freed = True
         # record what this slot's cache still holds (valid rows 0..len-1) so
         # a future prompt sharing the prefix skips that part of its prefill.
         # Shifted slots moved rows — their mapping is no longer positional.
@@ -1238,6 +1390,12 @@ class Engine:
         so no consumer blocks forever on its output queue."""
         self._pending = None
         self._prefillq.clear()
+        if self._deferred is not None:
+            rid, req, out = self._deferred
+            self._deferred = None
+            out.put(StepOutput(request_id=rid, text="", token_id=-1,
+                               logprob=0.0, finished=True,
+                               finish_reason=reason))
         for i, slot in enumerate(self._slots):
             if slot is None:
                 continue

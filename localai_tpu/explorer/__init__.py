@@ -223,17 +223,24 @@ async function refresh(){
  }
 }
 async function add(){
- await fetch('network/add',{method:'POST',headers:{'Content-Type':
+ const r=await fetch('network/add',{method:'POST',headers:{'Content-Type':
  'application/json'},body:JSON.stringify({name:name.value,url:url.value,
- description:desc.value})});refresh();
+ description:desc.value})});
+ if(!r.ok){alert('registration failed ('+r.status+'): '+await r.text()+
+ (r.status==401?' — this explorer requires signed registration '+
+ '(LOCALAI_FEDERATION_TOKEN); use the API with an X-LocalAI-Federation '+
+ 'header':''));return;}
+ refresh();
 }
 refresh();setInterval(refresh,10000);
 </script></body></html>"""
 
 
-def build_explorer_app(db: Database):
+def build_explorer_app(db: Database, register_token: str = ""):
     """aiohttp app with the reference's explorer routes
-    (routes/explorer.go:10-12)."""
+    (routes/explorer.go:10-12). `register_token` gates /network/add behind a
+    shared-token HMAC signature (federation/auth.py) so arbitrary parties
+    cannot pollute the flock registry."""
     from aiohttp import web
 
     async def dashboard(request):
@@ -250,6 +257,13 @@ def build_explorer_app(db: Database):
         return web.json_response(out)
 
     async def add_network(request):
+        if register_token:
+            from localai_tpu.federation.auth import HEADER, verify
+
+            raw = await request.read()
+            if not verify(register_token, request.headers.get(HEADER),
+                          request.method, request.path_qs, raw):
+                raise web.HTTPUnauthorized(text="registration token required")
         body = await request.json()
         url = (body.get("url") or body.get("token") or "").strip()
         if not url:
@@ -290,7 +304,11 @@ def run_explorer(args) -> int:
     host, _, port = getattr(args, "address", "127.0.0.1:8509").rpartition(":")
 
     async def serve():
-        runner = web.AppRunner(build_explorer_app(db))
+        import os
+
+        runner = web.AppRunner(build_explorer_app(
+            db, register_token=os.environ.get(
+                "LOCALAI_FEDERATION_TOKEN", "")))
         await runner.setup()
         site = web.TCPSite(runner, host or "127.0.0.1", int(port))
         await site.start()

@@ -33,13 +33,19 @@ class Worker:
 
 
 class FederatedServer:
+    """`token` enables the shared-token HMAC scheme (federation/auth.py —
+    the reference's p2p token+OTP role, p2p.go:31-66): incoming requests
+    must carry a valid X-LocalAI-Federation signature, and proxied requests
+    are re-signed so token-configured workers accept them."""
+
     def __init__(self, workers: list[str], strategy: str = "least_used",
-                 health_interval: float = 10.0):
+                 health_interval: float = 10.0, token: str = ""):
         if strategy not in ("least_used", "random", "round_robin"):
             raise ValueError(f"unknown strategy {strategy!r}")
         self.workers = [Worker(w) for w in workers]
         self.strategy = strategy
         self.health_interval = health_interval
+        self.token = token
         self._rr = itertools.count()
         self.app = web.Application()
         self.app.router.add_get("/healthz", self._health)
@@ -77,7 +83,17 @@ class FederatedServer:
         return web.json_response({"status": "ok",
                                   "workers": len(self.workers)})
 
+    def _authorized(self, request: web.Request, body: bytes) -> bool:
+        if not self.token:
+            return True
+        from localai_tpu.federation.auth import HEADER, verify
+
+        return verify(self.token, request.headers.get(HEADER),
+                      request.method, request.path_qs, body)
+
     async def _workers_info(self, request):
+        if not self._authorized(request, b""):
+            raise web.HTTPUnauthorized(text="federation token required")
         return web.json_response([{
             "url": w.url, "healthy": w.healthy, "in_flight": w.in_flight,
             "total": w.total,
@@ -87,6 +103,8 @@ class FederatedServer:
         if self._session is None:
             self._session = aiohttp.ClientSession()
         body = await request.read()
+        if not self._authorized(request, body):
+            raise web.HTTPUnauthorized(text="federation token required")
         last_error = None
         # try up to len(workers) distinct workers (federated_server.go:66-99
         # skip-to-next-replica behavior)
@@ -107,6 +125,14 @@ class FederatedServer:
                     url += "?" + request.query_string
                 headers = {k: v for k, v in request.headers.items()
                            if k.lower() not in ("host", "content-length")}
+                if self.token:
+                    from localai_tpu.federation.auth import HEADER, sign
+
+                    upstream_path = "/" + request.match_info["tail"]
+                    if request.query_string:
+                        upstream_path += "?" + request.query_string
+                    headers[HEADER] = sign(self.token, request.method,
+                                           upstream_path, body or b"")
                 async with self._session.request(
                         request.method, url, data=body or None,
                         headers=headers,
@@ -136,11 +162,15 @@ class FederatedServer:
 
 def run_federated(args) -> int:
     """CLI `federated` entrypoint (reference core/cli federated cmd)."""
+    import os
+
     workers = [w.strip() for w in (args.workers or "").split(",") if w.strip()]
     if not workers:
         print("no --workers given")
         return 1
-    srv = FederatedServer(workers, strategy=args.strategy)
+    token = (getattr(args, "token", "") or
+             os.environ.get("LOCALAI_FEDERATION_TOKEN", ""))
+    srv = FederatedServer(workers, strategy=args.strategy, token=token)
     host, _, port = args.address.rpartition(":")
     web.run_app(srv.app, host=host or "127.0.0.1", port=int(port),
                 print=lambda *a: print(f"federated LB on {args.address} → "

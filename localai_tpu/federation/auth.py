@@ -1,0 +1,56 @@
+"""Federation request authentication — shared-token HMAC with a rolling
+time window.
+
+Reference role: the cluster layer's shared token + OTP rendezvous
+(/root/reference/core/p2p/p2p.go:31-66: the token seeds an OTP that rotates
+on an interval and gates who may join/talk). Without a libp2p overlay the
+TPU framework's federation is plain HTTP, so the same trust model becomes a
+signed header:
+
+    X-LocalAI-Federation: <unix_ts>.<hex hmac_sha256(token,
+                              "{ts}:{METHOD}:{path_qs}:{sha256(body)}")>
+
+- the token never travels on the wire (only MACs of it),
+- the timestamp bounds replay to ±`skew` seconds (the OTP-interval role),
+- method/path+query/body binding stops a captured signature being replayed
+  against a different endpoint, parameters, or payload. Callers MUST pass
+  the path WITH its query string (aiohttp `request.path_qs`).
+"""
+from __future__ import annotations
+
+import hashlib
+import hmac
+import time
+
+HEADER = "X-LocalAI-Federation"
+DEFAULT_SKEW = 90.0
+
+
+def _mac(token: str, ts: int, method: str, path: str, body: bytes) -> str:
+    msg = f"{ts}:{method.upper()}:{path}:{hashlib.sha256(body).hexdigest()}"
+    return hmac.new(token.encode(), msg.encode(), hashlib.sha256).hexdigest()
+
+
+def sign(token: str, method: str, path: str, body: bytes = b"",
+         ts: int | None = None) -> str:
+    """Header value authenticating one request."""
+    ts = int(time.time()) if ts is None else int(ts)
+    return f"{ts}.{_mac(token, ts, method, path, body)}"
+
+
+def verify(token: str, header: str | None, method: str, path: str,
+           body: bytes = b"", skew: float = DEFAULT_SKEW,
+           now: float | None = None) -> bool:
+    """Constant-time verification of a signed header; False on anything
+    malformed, stale, or forged."""
+    if not token or not header or "." not in header:
+        return False
+    ts_s, _, mac = header.partition(".")
+    try:
+        ts = int(ts_s)
+    except ValueError:
+        return False
+    now = time.time() if now is None else now
+    if abs(now - ts) > skew:
+        return False
+    return hmac.compare_digest(mac, _mac(token, ts, method, path, body))

@@ -217,12 +217,21 @@ def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int, dtype=None,
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
 
-def _cache_write(kc, vc, k, v, rows, positions):
+def _cache_write(kc, vc, k, v, rows, positions, table=None):
     """Scatter window K/V [B, S, KVH, D] into head-major caches [B', KVH, T, D]
-    at (rows[b], :, positions[b, s])."""
+    at (rows[b], :, positions[b, s]). With a paged `table` [B, MAXB] the cache
+    is a block pool [NB, KVH, BS, D] and (slot, position) resolves to
+    (table[slot, pos // BS], :, pos % BS) — ops/paged.py layout."""
     kvh = kc.shape[1]
-    idx = (rows[:, None, None], jnp.arange(kvh)[None, :, None],
-           positions[:, None, :])
+    if table is None:
+        idx = (rows[:, None, None], jnp.arange(kvh)[None, :, None],
+               positions[:, None, :])
+    else:
+        from localai_tpu.ops.paged import BLOCK
+
+        pb = table[rows[:, None], positions // BLOCK]      # [B, S] physical
+        idx = (pb[:, None, :], jnp.arange(kvh)[None, :, None],
+               (positions % BLOCK)[:, None, :])
     if isinstance(kc, QuantKV):
         return (cache_scatter(kc, idx, k.transpose(0, 2, 1, 3)),
                 cache_scatter(vc, idx, v.transpose(0, 2, 1, 3)))
@@ -307,10 +316,15 @@ def _seq_ax():
     return "seq" if seq_axis_size(current_mesh()) > 1 else None
 
 
-def _decode_dq(q, kc, vc, lengths, sliding_window=None):
+def _decode_dq(q, kc, vc, lengths, sliding_window=None, table=None):
     """XLA decode attention over a (possibly quantized) cache: dequant is
     fused into the consuming dots by XLA; quantized caches still halve HBM
-    capacity on this path."""
+    capacity on this path. A paged cache is materialized per layer via
+    gather (reference tier — the Pallas kernels stream through the table)."""
+    if table is not None:
+        from localai_tpu.ops.paged import paged_view
+
+        kc, vc = paged_view(kc, table), paged_view(vc, table)
     return mha_decode(q, dequant(kc), dequant(vc), lengths,
                       sliding_window=sliding_window)
 
@@ -340,6 +354,7 @@ def _attn_impls(cfg: LlamaConfig | None = None, kv_quant: bool = False):
                     ring_prefill(q, k, v, lengths, mesh=mesh,
                                  sliding_window=sliding_window),
                     _decode_dq)
+        return mha_prefill, _decode_dq
     use = force or (not block and jax.default_backend() == "tpu"
                     and current_mesh() is None)
     if use and not force:
@@ -359,12 +374,13 @@ def _attn_impls(cfg: LlamaConfig | None = None, kv_quant: bool = False):
             flash_prefill, ragged_decode, ragged_decode_q8,
         )
 
-        def attn_decode(q, kc, vc, lengths, sliding_window=None):
+        def attn_decode(q, kc, vc, lengths, sliding_window=None, table=None):
             if isinstance(kc, QuantKV):
                 return ragged_decode_q8(q, kc.q, kc.s, vc.q, vc.s, lengths,
-                                        sliding_window=sliding_window)
+                                        sliding_window=sliding_window,
+                                        table=table)
             return ragged_decode(q, kc, vc, lengths,
-                                 sliding_window=sliding_window)
+                                 sliding_window=sliding_window, table=table)
 
         return (lambda q, k, v, lengths, sliding_window=None:
                 flash_prefill(q, k, v, lengths, sliding_window=sliding_window),
@@ -373,11 +389,12 @@ def _attn_impls(cfg: LlamaConfig | None = None, kv_quant: bool = False):
 
 
 def prefill(params, cfg: LlamaConfig, tokens, lengths, cos, sin,
-            k_cache, v_cache, slot_map):
+            k_cache, v_cache, slot_map, table=None):
     """Process padded prompt batch, writing K/V into slot rows of the cache.
 
     tokens: [B, S] i32 (padded); lengths: [B]; slot_map: [B] i32 — which cache
-    slot each batch row writes into; cos/sin: rope tables.
+    slot each batch row writes into; cos/sin: rope tables; table: optional
+    paged block table (ops/paged.py).
     Returns (last_token_logits [B, V] f32, k_cache, v_cache).
     """
     b, s = tokens.shape
@@ -398,7 +415,7 @@ def prefill(params, cfg: LlamaConfig, tokens, lengths, cos, sin,
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         x = x + _mlp(h, lp, cfg)
         x = _shard_act(x, P("data", _seq_ax(), None))
-        kc, vc = _cache_write(kc, vc, k, v, slot_map, positions)
+        kc, vc = _cache_write(kc, vc, k, v, slot_map, positions, table)
         return x, (kc, vc)
 
     x, (k_cache, v_cache) = jax.lax.scan(
@@ -413,7 +430,7 @@ def prefill(params, cfg: LlamaConfig, tokens, lengths, cos, sin,
 
 
 def decode_step(params, cfg: LlamaConfig, tokens, lengths, cos, sin,
-                k_cache, v_cache, active=None):
+                k_cache, v_cache, active=None, table=None):
     """One continuous-batching decode step over ALL slots.
 
     tokens: [B] i32 — last sampled token per slot; lengths: [B] — cache entries
@@ -423,10 +440,13 @@ def decode_step(params, cfg: LlamaConfig, tokens, lengths, cos, sin,
     the last cache row (never a readable position — the engine terminates at
     max_context-1) so a decode step can run concurrently with a chunked
     prefill into an inactive slot without corrupting it.
+    `table` [B, MAXB] i32 (optional): block-paged cache (ops/paged.py) — the
+    redirect row then resolves through the table's last virtual block, which
+    is the trash block for any slot not allocated to full context.
     Returns (logits [B, V] f32, k_cache, v_cache).
     """
     b = tokens.shape[0]
-    T = k_cache.shape[3]
+    T = k_cache.shape[3] if table is None else table.shape[1] * 128
     _, attn_decode = _attn_impls(cfg, kv_quant=isinstance(k_cache, QuantKV))
     positions = lengths[:, None]  # [B,1]
     wpos = positions if active is None else jnp.where(
@@ -439,9 +459,9 @@ def decode_step(params, cfg: LlamaConfig, tokens, lengths, cos, sin,
         q, k, v = _qkv(h, lp, cfg)
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
-        kc, vc = _cache_write(kc, vc, k, v, jnp.arange(b), wpos)
+        kc, vc = _cache_write(kc, vc, k, v, jnp.arange(b), wpos, table)
         attn = attn_decode(q, kc, vc, lengths + 1,
-                           sliding_window=cfg.sliding_window)
+                           sliding_window=cfg.sliding_window, table=table)
         x = x + qmatmul(attn.reshape(b, 1, -1), lp["wo"])
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         x = x + _mlp(h, lp, cfg)
@@ -485,7 +505,8 @@ def hidden_states(params, cfg: LlamaConfig, tokens, lengths=None):
 
 
 def extend(params, cfg: LlamaConfig, tokens, start, cos, sin,
-           k_cache, v_cache, slot_map=None, with_logits=True, last_pos=None):
+           k_cache, v_cache, slot_map=None, with_logits=True, last_pos=None,
+           table=None):
     """Forward a window of S tokens per slot starting at cache offset
     `start` [B] — the speculative-decoding verification pass (reference knob:
     DraftModel/NDraft, /root/reference/backend/backend.proto:218,150) and the
@@ -512,9 +533,15 @@ def extend(params, cfg: LlamaConfig, tokens, start, cos, sin,
         q, k, v = _qkv(h, lp, cfg)
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
-        kc, vc = _cache_write(kc, vc, k, v, rows, positions)
-        kr = kc if slot_map is None else kc[rows]
-        vr = vc if slot_map is None else vc[rows]
+        kc, vc = _cache_write(kc, vc, k, v, rows, positions, table)
+        if table is not None:
+            from localai_tpu.ops.paged import paged_view
+
+            kr = paged_view(kc, table[rows])
+            vr = paged_view(vc, table[rows])
+        else:
+            kr = kc if slot_map is None else kc[rows]
+            vr = vc if slot_map is None else vc[rows]
         attn = mha_extend(q, dequant(kr), dequant(vr), positions,
                           sliding_window=cfg.sliding_window)
         x = x + qmatmul(attn.reshape(b, s, -1), lp["wo"])

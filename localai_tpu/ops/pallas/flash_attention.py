@@ -206,21 +206,72 @@ def _decode_kernel(lengths_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0, 0] = out.astype(o_ref.dtype)
 
 
+def _decode_kernel_paged(lengths_ref, table_ref, *refs, **kw):
+    # table is consumed by the index maps only; the body math is identical
+    _decode_kernel(lengths_ref, *refs, **kw)
+
+
 @functools.partial(jax.jit, static_argnames=("sliding_window", "block_k"))
 def ragged_decode(q, k_cache, v_cache, lengths, sliding_window=None,
-                  block_k: int = 256):
+                  block_k: int = 256, table=None):
     """Decode-step GQA attention. q: [B, 1, H, D]; caches [B, KVH, T, D];
     lengths: [B] valid entries incl. the newly-written token.
-    Returns [B, 1, H, D]."""
+    Returns [B, 1, H, D].
+
+    Paged mode (`table` [B, MAXB] i32, ops/paged.py): caches are a block
+    pool [NB, KVH, BS, D]; virtual KV block kb of slot b streams from
+    physical block table[b, kb]. Same O(valid tokens) traffic — the clamp
+    repeats the physical index past the valid length and Mosaic skips the
+    duplicate DMA."""
     B, _, H, D = q.shape
-    KVH, T = k_cache.shape[1], k_cache.shape[2]
+    KVH = k_cache.shape[1]   # axis 1 in both layouts ([B,KVH,T,D] / pool)
     group = H // KVH
+    scale = D ** -0.5
+    qg = q.reshape(B, KVH, group, D)
+
+    if table is not None:
+        BS = k_cache.shape[2]            # pool [NB, KVH, BS, D]
+        num_kb = table.shape[1]
+        T = num_kb * BS
+
+        def kv_map(b, h, kb, lens, tab):
+            last = jnp.maximum(pl.cdiv(lens[b], BS) - 1, 0)
+            return (tab[b, jnp.minimum(kb, last)], h, 0, 0)
+
+        kernel = functools.partial(_decode_kernel_paged, block_k=BS,
+                                   num_kb=num_kb, t_total=T, scale=scale,
+                                   sliding_window=sliding_window)
+        out = pl.pallas_call(
+            kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(B, KVH, num_kb),
+                in_specs=[
+                    pl.BlockSpec((1, 1, group, D),
+                                 lambda b, h, kb, lens, tab: (b, h, 0, 0)),
+                    pl.BlockSpec((1, 1, BS, D), kv_map),
+                    pl.BlockSpec((1, 1, BS, D), kv_map),
+                ],
+                out_specs=pl.BlockSpec((1, 1, group, D),
+                                       lambda b, h, kb, lens, tab:
+                                       (b, h, 0, 0)),
+                scratch_shapes=[
+                    pltpu.VMEM((group, 128), jnp.float32),
+                    pltpu.VMEM((group, 128), jnp.float32),
+                    pltpu.VMEM((group, D), jnp.float32),
+                ],
+            ),
+            out_shape=jax.ShapeDtypeStruct(qg.shape, q.dtype),
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary")),
+            interpret=_interpret(),
+        )(lengths.astype(jnp.int32), table.astype(jnp.int32), qg,
+          k_cache, v_cache)
+        return out.reshape(B, 1, H, D)
+
+    T = k_cache.shape[2]
     block_k = min(block_k, T)
     num_kb = pl.cdiv(T, block_k)
-    scale = D ** -0.5
-
-    # one (slot, kv head) pair per grid row; its q block is the GQA group
-    qg = q.reshape(B, KVH, group, D)
 
     def kv_map(b, h, kb, lens):
         # clamp beyond-length blocks to the last valid one: Mosaic skips the
@@ -263,11 +314,13 @@ def ragged_decode(q, k_cache, v_cache, lengths, sliding_window=None,
 def _decode_q8_kernel(lengths_ref, q_ref, kq_ref, ks_ref, vq_ref, vs_ref,
                       o_ref, m_ref, l_ref, acc_ref, *,
                       num_kb: int, t_total: int, scale: float,
-                      sliding_window: int | None):
+                      sliding_window: int | None, paged: bool = False):
     """ragged_decode against an int8 cache: K/V stream from HBM as int8 (half
     the decode bandwidth — the resource decode is bound by); scales are one
     aligned [1, 128] row per 128-token block, applied to score columns (K) and
-    to p's columns before the p@v matmul (V) so the matmuls stay dense."""
+    to p's columns before the p@v matmul (V) so the matmuls stay dense.
+    paged=True: the scale ref is the single [1, 128] row of this physical
+    block (table-mapped) instead of the slot's whole scale strip."""
     b = pl.program_id(0)
     kb = pl.program_id(2)
     length = lengths_ref[b]
@@ -289,8 +342,12 @@ def _decode_q8_kernel(lengths_ref, q_ref, kq_ref, ks_ref, vq_ref, vs_ref,
         q = q_ref[0, 0].astype(jnp.float32) * scale            # [G, D]
         k_blk = kq_ref[0, 0].astype(jnp.float32)               # [BK, D]
         v_blk = vq_ref[0, 0].astype(jnp.float32)
-        k_s = ks_ref[0, 0, pl.ds(kb, 1), :]                    # [1, BK]
-        v_s = vs_ref[0, 0, pl.ds(kb, 1), :]
+        if paged:
+            k_s = ks_ref[0, 0]                                 # [1, BK]
+            v_s = vs_ref[0, 0]
+        else:
+            k_s = ks_ref[0, 0, pl.ds(kb, 1), :]                # [1, BK]
+            v_s = vs_ref[0, 0, pl.ds(kb, 1), :]
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
         s = s * k_s                                            # dequant K
         k_pos = start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
@@ -313,22 +370,75 @@ def _decode_q8_kernel(lengths_ref, q_ref, kq_ref, ks_ref, vq_ref, vs_ref,
         o_ref[0, 0] = out.astype(o_ref.dtype)
 
 
+def _decode_q8_kernel_paged(lengths_ref, table_ref, *refs, **kw):
+    _decode_q8_kernel(lengths_ref, *refs, paged=True, **kw)
+
+
 @functools.partial(jax.jit, static_argnames=("sliding_window",))
-def ragged_decode_q8(q, k_q, k_s, v_q, v_s, lengths, sliding_window=None):
+def ragged_decode_q8(q, k_q, k_s, v_q, v_s, lengths, sliding_window=None,
+                     table=None):
     """Decode-step GQA attention over an int8 KV cache (ops/kvcache.py
     layout). q: [B, 1, H, D]; k_q/v_q: [B, KVH, T, D] int8;
     k_s/v_s: [B, KVH, T//128, 128] f32 (token t's scale at [t//128, t%128]);
-    lengths: [B]. T must be a multiple of 128. Returns [B, 1, H, D]."""
+    lengths: [B]. T must be a multiple of 128. Returns [B, 1, H, D].
+
+    Paged mode (`table` [B, MAXB] i32): k_q/v_q are a block pool
+    [NB, KVH, 128, D] with scales [NB, KVH, 1, 128] (ops/paged.py)."""
     B, _, H, D = q.shape
-    KVH, T = k_q.shape[1], k_q.shape[2]
+    KVH = k_q.shape[1]
+    group = H // KVH
+    scale = D ** -0.5
+    qg = q.reshape(B, KVH, group, D)
+
+    if table is not None:
+        BS = k_q.shape[2]
+        if BS != 128:
+            raise ValueError("paged int8 KV blocks must be 128 tokens")
+        num_kb = table.shape[1]
+        T = num_kb * BS
+
+        def kv_map(b, h, kb, lens, tab):
+            last = jnp.maximum(pl.cdiv(lens[b], BS) - 1, 0)
+            return (tab[b, jnp.minimum(kb, last)], h, 0, 0)
+
+        kernel = functools.partial(_decode_q8_kernel_paged, num_kb=num_kb,
+                                   t_total=T, scale=scale,
+                                   sliding_window=sliding_window)
+        out = pl.pallas_call(
+            kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(B, KVH, num_kb),
+                in_specs=[
+                    pl.BlockSpec((1, 1, group, D),
+                                 lambda b, h, kb, lens, tab: (b, h, 0, 0)),
+                    pl.BlockSpec((1, 1, BS, D), kv_map),
+                    pl.BlockSpec((1, 1, 1, 128), kv_map),
+                    pl.BlockSpec((1, 1, BS, D), kv_map),
+                    pl.BlockSpec((1, 1, 1, 128), kv_map),
+                ],
+                out_specs=pl.BlockSpec((1, 1, group, D),
+                                       lambda b, h, kb, lens, tab:
+                                       (b, h, 0, 0)),
+                scratch_shapes=[
+                    pltpu.VMEM((group, 128), jnp.float32),
+                    pltpu.VMEM((group, 128), jnp.float32),
+                    pltpu.VMEM((group, D), jnp.float32),
+                ],
+            ),
+            out_shape=jax.ShapeDtypeStruct(qg.shape, q.dtype),
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary")),
+            interpret=_interpret(),
+        )(lengths.astype(jnp.int32), table.astype(jnp.int32), qg,
+          k_q, k_s.astype(jnp.float32), v_q, v_s.astype(jnp.float32))
+        return out.reshape(B, 1, H, D)
+
+    T = k_q.shape[2]
     if T % 128:
         raise ValueError("int8 KV cache length must be a multiple of 128")
-    group = H // KVH
     num_kb = T // 128
-    scale = D ** -0.5
     n_tiles = k_s.shape[2]
-
-    qg = q.reshape(B, KVH, group, D)
 
     def kv_map(b, h, kb, lens):
         last = jnp.maximum(pl.cdiv(lens[b], 128) - 1, 0)

@@ -115,6 +115,22 @@ class API:
 
     # ------------------------------------------------------------ middleware
 
+    async def _federation_ok(self, request: web.Request) -> bool:
+        """A valid shared-token HMAC signature (federation/auth.py — the
+        reference's p2p token role, p2p.go:31-66) authorizes a request like
+        an API key: that's how a federation LB reaches api-key-protected
+        workers without distributing the keys."""
+        if not getattr(self.cfg, "federation_token", ""):
+            return False
+        from localai_tpu.federation.auth import HEADER, verify
+
+        header = request.headers.get(HEADER)
+        if not header:
+            return False
+        body = await request.read()   # aiohttp caches; handlers re-read
+        return verify(self.cfg.federation_token, header, request.method,
+                      request.path_qs, body)
+
     @web.middleware
     async def _middleware(self, request: web.Request, handler):
         t0 = time.perf_counter()
@@ -123,7 +139,8 @@ class API:
             if self.cfg.api_keys and request.path not in _OPEN_PATHS:
                 auth = request.headers.get("Authorization", "")
                 key = auth.removeprefix("Bearer ").strip()
-                if key not in self.cfg.api_keys:
+                if key not in self.cfg.api_keys and not (
+                        await self._federation_ok(request)):
                     status = 401
                     return web.json_response(
                         schema.error_body("invalid api key",

@@ -1,0 +1,390 @@
+"""GGUF ingestion — parse, dequantize, and convert GGUF checkpoints to the
+HF-layout (config.json + model.safetensors + tokenizer.json) this engine
+loads.
+
+Role: the reference is GGUF-first — its gallery/config guesser reads GGUF
+metadata (/root/reference/core/config/gguf.go, guesser.go:11-46) and its
+flagship backend serves GGUF directly via llama.cpp. Here GGUF is an IMPORT
+format: quantized blocks are decoded once to f32/f16 tensors (the engine
+re-quantizes to int8 on device at load, ops/quant.py), metadata synthesizes
+config.json (the guesser role), and the embedded tokenizer becomes a HF
+tokenizer.json. Clean-room implementation from the public GGUF/GGML layout.
+
+Supported tensor types: F32, F16, BF16, Q8_0, Q4_0, Q4_1, Q5_0, Q5_1, Q6_K.
+"""
+from __future__ import annotations
+
+import json
+import os
+import struct
+
+import numpy as np
+
+GGUF_MAGIC = b"GGUF"
+
+# metadata value types
+_T_U8, _T_I8, _T_U16, _T_I16, _T_U32, _T_I32, _T_F32, _T_BOOL, _T_STR, \
+    _T_ARR, _T_U64, _T_I64, _T_F64 = range(13)
+
+_SCALAR = {
+    _T_U8: ("<B", 1), _T_I8: ("<b", 1), _T_U16: ("<H", 2), _T_I16: ("<h", 2),
+    _T_U32: ("<I", 4), _T_I32: ("<i", 4), _T_F32: ("<f", 4),
+    _T_BOOL: ("<?", 1), _T_U64: ("<Q", 8), _T_I64: ("<q", 8),
+    _T_F64: ("<d", 8),
+}
+
+# ggml tensor types → (block_elems, block_bytes)
+GGML_F32, GGML_F16 = 0, 1
+GGML_Q4_0, GGML_Q4_1 = 2, 3
+GGML_Q5_0, GGML_Q5_1 = 6, 7
+GGML_Q8_0 = 8
+GGML_Q6_K = 14
+GGML_BF16 = 30
+
+_BLOCK = {
+    GGML_F32: (1, 4), GGML_F16: (1, 2), GGML_BF16: (1, 2),
+    GGML_Q4_0: (32, 18), GGML_Q4_1: (32, 20),
+    GGML_Q5_0: (32, 22), GGML_Q5_1: (32, 24),
+    GGML_Q8_0: (32, 34), GGML_Q6_K: (256, 210),
+}
+
+
+class _Reader:
+    def __init__(self, buf: memoryview):
+        self.buf = buf
+        self.pos = 0
+
+    def scalar(self, t):
+        fmt, n = _SCALAR[t]
+        v = struct.unpack_from(fmt, self.buf, self.pos)[0]
+        self.pos += n
+        return v
+
+    def string(self) -> str:
+        n = self.scalar(_T_U64)
+        s = bytes(self.buf[self.pos:self.pos + n]).decode("utf-8",
+                                                          errors="replace")
+        self.pos += n
+        return s
+
+    def value(self, t):
+        if t == _T_STR:
+            return self.string()
+        if t == _T_ARR:
+            et = self.scalar(_T_U32)
+            n = self.scalar(_T_U64)
+            if et in _SCALAR and et != _T_BOOL:
+                fmt, sz = _SCALAR[et]
+                out = np.frombuffer(self.buf, dtype=np.dtype(fmt[1:]).newbyteorder("<"),
+                                    count=n, offset=self.pos)
+                self.pos += n * sz
+                return out.tolist()
+            return [self.value(et) for _ in range(n)]
+        return self.scalar(t)
+
+
+def parse_gguf(path: str):
+    """Parse header + metadata + tensor directory. Returns
+    (metadata: dict, tensors: {name: (shape, ggml_type, abs_offset)}, mmap).
+    Shapes are numpy order (GGUF stores dims reversed)."""
+    mm = np.memmap(path, dtype=np.uint8, mode="r")
+    buf = memoryview(mm)
+    if bytes(buf[:4]) != GGUF_MAGIC:
+        raise ValueError(f"{path}: not a GGUF file")
+    r = _Reader(buf)
+    r.pos = 4
+    version = r.scalar(_T_U32)
+    if version not in (2, 3):
+        raise ValueError(f"unsupported GGUF version {version}")
+    n_tensors = r.scalar(_T_U64)
+    n_kv = r.scalar(_T_U64)
+    meta = {}
+    for _ in range(n_kv):
+        key = r.string()
+        t = r.scalar(_T_U32)
+        meta[key] = r.value(t)
+    infos = []
+    for _ in range(n_tensors):
+        name = r.string()
+        nd = r.scalar(_T_U32)
+        dims = [r.scalar(_T_U64) for _ in range(nd)]
+        ttype = r.scalar(_T_U32)
+        off = r.scalar(_T_U64)
+        infos.append((name, tuple(reversed(dims)), ttype, off))
+    align = int(meta.get("general.alignment", 32))
+    data_start = (r.pos + align - 1) // align * align
+    tensors = {n: (s, t, data_start + o) for n, s, t, o in infos}
+    return meta, tensors, mm
+
+
+# ------------------------------------------------------------- dequantize
+
+def _f16(b):
+    return b.view(np.float16).astype(np.float32)
+
+
+def dequantize(raw: np.ndarray, ggml_type: int, shape) -> np.ndarray:
+    """Decode one tensor's raw bytes to f32 (f16 kept as f16 to halve disk)."""
+    n = int(np.prod(shape))
+    if ggml_type == GGML_F32:
+        return raw.view(np.float32)[:n].reshape(shape)
+    if ggml_type == GGML_F16:
+        return raw.view(np.float16)[:n].reshape(shape)
+    if ggml_type == GGML_BF16:
+        out = np.zeros((n,), np.float32)
+        out.view(np.uint32)[:] = raw.view(np.uint16)[:n].astype(np.uint32) << 16
+        return out.reshape(shape)
+    be, bb = _BLOCK[ggml_type]
+    nb = n // be
+    blocks = raw[: nb * bb].reshape(nb, bb)
+    if ggml_type == GGML_Q8_0:
+        d = _f16(blocks[:, :2].copy())[:, 0]
+        q = blocks[:, 2:].view(np.int8).astype(np.float32)
+        out = q * d[:, None]
+    elif ggml_type in (GGML_Q4_0, GGML_Q4_1):
+        if ggml_type == GGML_Q4_0:
+            d = _f16(blocks[:, :2].copy())[:, 0][:, None]
+            m = -8.0 * d
+            qs = blocks[:, 2:]
+        else:
+            d = _f16(blocks[:, :2].copy())[:, 0][:, None]
+            m = _f16(blocks[:, 2:4].copy())[:, 0][:, None]
+            qs = blocks[:, 4:]
+        lo = (qs & 0x0F).astype(np.float32)
+        hi = (qs >> 4).astype(np.float32)
+        out = np.concatenate([lo, hi], axis=1) * d + m
+    elif ggml_type in (GGML_Q5_0, GGML_Q5_1):
+        d = _f16(blocks[:, :2].copy())[:, 0][:, None]
+        if ggml_type == GGML_Q5_1:
+            m = _f16(blocks[:, 2:4].copy())[:, 0][:, None]
+            qh = blocks[:, 4:8].copy().view(np.uint32)[:, 0]
+            qs = blocks[:, 8:]
+        else:
+            m = -16.0 * d
+            qh = blocks[:, 2:6].copy().view(np.uint32)[:, 0]
+            qs = blocks[:, 6:]
+        lo = (qs & 0x0F).astype(np.uint8)
+        hi = (qs >> 4).astype(np.uint8)
+        q = np.concatenate([lo, hi], axis=1).astype(np.float32)
+        bits = ((qh[:, None] >> np.arange(32)[None, :]) & 1).astype(np.float32)
+        out = (q + bits * 16.0) * d + m
+    elif ggml_type == GGML_Q6_K:
+        # block 256: ql[128] qh[64] scales[16] d(f16)
+        ql = blocks[:, :128]
+        qh = blocks[:, 128:192]
+        sc = blocks[:, 192:208].view(np.int8).astype(np.float32)
+        d = _f16(blocks[:, 208:210].copy())[:, 0]
+        out = np.zeros((nb, 256), np.float32)
+        for g in range(2):                      # two 128-elem halves
+            qlh = ql[:, g * 64:(g + 1) * 64]
+            qhh = qh[:, g * 32:(g + 1) * 32]
+            base = g * 128
+            for j in range(4):                  # 4 32-elem quarters
+                if j < 2:
+                    lowq = (qlh[:, j * 32:(j + 1) * 32] & 0x0F)
+                else:
+                    lowq = (qlh[:, (j - 2) * 32:(j - 1) * 32] >> 4)
+                high = ((qhh >> (2 * j)) & 3).astype(np.uint8)
+                q = (lowq | (high << 4)).astype(np.float32) - 32.0
+                s = sc[:, g * 8 + j * 2:g * 8 + j * 2 + 2]
+                # scales apply per 16 elems
+                q[:, :16] *= s[:, 0:1]
+                q[:, 16:] *= s[:, 1:2]
+                out[:, base + j * 32: base + (j + 1) * 32] = q * d[:, None]
+    else:
+        raise ValueError(f"unsupported ggml tensor type {ggml_type}")
+    return out.reshape(shape)
+
+
+# ------------------------------------------------------------- name mapping
+
+def _unpermute(w: np.ndarray, n_head: int) -> np.ndarray:
+    """Invert llama.cpp's q/k row permutation (convert_hf_to_gguf permute):
+    GGUF stores wq/wk with rows reordered for GGML's interleaved rope; the
+    HF layout this engine expects needs them back."""
+    out_dim = w.shape[0]
+    return (w.reshape(n_head, out_dim // n_head // 2, 2, *w.shape[1:])
+             .swapaxes(1, 2)
+             .reshape(w.shape))
+
+
+def map_tensors(tensors: dict, meta: dict) -> dict:
+    """GGUF tensor names → HF llama names (+ the q/k unpermute marker).
+    Returns {hf_name: (gguf_name, unpermute_heads | None)}."""
+    arch = meta.get("general.architecture", "llama")
+    nh = int(meta.get(f"{arch}.attention.head_count", 32))
+    nkv = int(meta.get(f"{arch}.attention.head_count_kv", nh))
+    out = {
+        "model.embed_tokens.weight": ("token_embd.weight", None),
+        "model.norm.weight": ("output_norm.weight", None),
+    }
+    if "output.weight" in tensors:
+        out["lm_head.weight"] = ("output.weight", None)
+    i = 0
+    while f"blk.{i}.attn_q.weight" in tensors:
+        L = f"model.layers.{i}."
+        B = f"blk.{i}."
+        out[L + "input_layernorm.weight"] = (B + "attn_norm.weight", None)
+        out[L + "self_attn.q_proj.weight"] = (B + "attn_q.weight", nh)
+        out[L + "self_attn.k_proj.weight"] = (B + "attn_k.weight", nkv)
+        out[L + "self_attn.v_proj.weight"] = (B + "attn_v.weight", None)
+        out[L + "self_attn.o_proj.weight"] = (B + "attn_output.weight", None)
+        out[L + "post_attention_layernorm.weight"] = (B + "ffn_norm.weight",
+                                                      None)
+        out[L + "mlp.gate_proj.weight"] = (B + "ffn_gate.weight", None)
+        out[L + "mlp.up_proj.weight"] = (B + "ffn_up.weight", None)
+        out[L + "mlp.down_proj.weight"] = (B + "ffn_down.weight", None)
+        for bias in ("q", "k", "v"):
+            if B + f"attn_{bias}.bias" in tensors:
+                out[L + f"self_attn.{bias}_proj.bias"] = (
+                    B + f"attn_{bias}.bias",
+                    (nh if bias == "q" else nkv))
+        i += 1
+    return out
+
+
+def synth_config(meta: dict, tensors: dict) -> dict:
+    """GGUF metadata → HF config.json (the reference guesser.go role)."""
+    arch = meta.get("general.architecture", "llama")
+    nh = int(meta.get(f"{arch}.attention.head_count", 32))
+    vocab = len(meta.get("tokenizer.ggml.tokens", [])) or int(
+        meta.get(f"{arch}.vocab_size", 32000))
+    cfg = {
+        "architectures": ["LlamaForCausalLM"],
+        "vocab_size": vocab,
+        "hidden_size": int(meta.get(f"{arch}.embedding_length", 4096)),
+        "intermediate_size": int(meta.get(f"{arch}.feed_forward_length",
+                                          11008)),
+        "num_hidden_layers": int(meta.get(f"{arch}.block_count", 32)),
+        "num_attention_heads": nh,
+        "num_key_value_heads": int(meta.get(
+            f"{arch}.attention.head_count_kv", nh)),
+        "max_position_embeddings": int(meta.get(f"{arch}.context_length",
+                                                8192)),
+        "rms_norm_eps": float(meta.get(
+            f"{arch}.attention.layer_norm_rms_epsilon", 1e-5)),
+        "rope_theta": float(meta.get(f"{arch}.rope.freq_base", 10000.0)),
+        "tie_word_embeddings": "output.weight" not in tensors,
+        "model_type": "llama",
+        "localai_gguf_import": True,
+    }
+    if f"{arch}.attention.key_length" in meta:
+        cfg["head_dim"] = int(meta[f"{arch}.attention.key_length"])
+    if f"{arch}.rope.scaling.factor" in meta:
+        cfg["rope_scaling"] = {
+            "rope_type": meta.get(f"{arch}.rope.scaling.type", "linear"),
+            "factor": float(meta[f"{arch}.rope.scaling.factor"]),
+        }
+    eos = meta.get("tokenizer.ggml.eos_token_id")
+    bos = meta.get("tokenizer.ggml.bos_token_id")
+    if eos is not None:
+        cfg["eos_token_id"] = int(eos)
+    if bos is not None:
+        cfg["bos_token_id"] = int(bos)
+    return cfg
+
+
+def synth_tokenizer(meta: dict) -> dict | None:
+    """Embedded GGUF vocab → HF tokenizer.json dict.
+
+    tokenizer.ggml.model: "gpt2" → byte-level BPE (tokens + merges);
+    "llama" → sentencepiece-style Unigram (tokens + scores, byte fallback).
+    """
+    tokens = meta.get("tokenizer.ggml.tokens")
+    if not tokens:
+        return None
+    model = meta.get("tokenizer.ggml.model", "llama")
+    ttypes = meta.get("tokenizer.ggml.token_type") or [1] * len(tokens)
+    added = [
+        {"id": i, "content": t, "special": True}
+        for i, (t, tt) in enumerate(zip(tokens, ttypes))
+        if tt in (3, 4)    # CONTROL=3, USER_DEFINED=4
+    ]
+    if model == "gpt2":
+        merges = meta.get("tokenizer.ggml.merges") or []
+        return {
+            "version": "1.0",
+            "added_tokens": added,
+            "pre_tokenizer": {"type": "ByteLevel", "add_prefix_space": False,
+                              "trim_offsets": True, "use_regex": True},
+            "decoder": {"type": "ByteLevel", "add_prefix_space": True,
+                        "trim_offsets": True, "use_regex": True},
+            "model": {
+                "type": "BPE",
+                "vocab": {t: i for i, t in enumerate(tokens)},
+                "merges": merges,
+                "byte_fallback": False,
+            },
+        }
+    scores = meta.get("tokenizer.ggml.scores") or [0.0] * len(tokens)
+    return {
+        "version": "1.0",
+        "added_tokens": added,
+        "normalizer": {"type": "Sequence", "normalizers": [
+            {"type": "Prepend", "prepend": "▁"},
+            {"type": "Replace", "pattern": {"String": " "}, "content": "▁"},
+        ]},
+        "decoder": {"type": "Sequence", "decoders": [
+            {"type": "Replace", "pattern": {"String": "▁"}, "content": " "},
+            {"type": "ByteFallback"},
+            {"type": "Fuse"},
+            {"type": "Strip", "content": " ", "start": 1, "stop": 0},
+        ]},
+        "model": {
+            "type": "Unigram",
+            "unk_id": int(meta.get("tokenizer.ggml.unknown_token_id", 0)),
+            "vocab": [[t, float(s)] for t, s in zip(tokens, scores)],
+            "byte_fallback": True,
+        },
+    }
+
+
+# ------------------------------------------------------------- conversion
+
+def convert_gguf(path: str, out_dir: str) -> str:
+    """GGUF file → HF checkpoint dir (config.json + model.safetensors +
+    tokenizer.json). Returns out_dir. Dequantizes once; f16/f32 preserved,
+    quantized types decoded to f16 (the engine re-quantizes on device)."""
+    from safetensors.numpy import save_file
+
+    meta, tensors, mm = parse_gguf(path)
+    mapping = map_tensors(tensors, meta)
+    missing = [h for h, (g, _) in mapping.items() if g not in tensors]
+    if missing:
+        raise ValueError(f"GGUF missing tensors for {missing[:4]}...")
+    os.makedirs(out_dir, exist_ok=True)
+    out = {}
+    for hf_name, (gguf_name, unperm) in mapping.items():
+        shape, ttype, off = tensors[gguf_name]
+        be, bb = _BLOCK[ttype]
+        nbytes = int(np.prod(shape)) // be * bb
+        raw = np.asarray(mm[off:off + nbytes])
+        w = dequantize(raw, ttype, shape)
+        if unperm is not None:
+            w = _unpermute(w, unperm)   # 1-D q/k biases are permuted too
+        if w.dtype == np.float32 and ttype not in (GGML_F32,):
+            w = w.astype(np.float16)   # quantized sources → f16 on disk
+        out[hf_name] = np.ascontiguousarray(w)
+    save_file(out, os.path.join(out_dir, "model.safetensors"))
+    with open(os.path.join(out_dir, "config.json"), "w") as f:
+        json.dump(synth_config(meta, tensors), f, indent=1)
+    tok = synth_tokenizer(meta)
+    if tok is not None:
+        with open(os.path.join(out_dir, "tokenizer.json"), "w") as f:
+            json.dump(tok, f)
+    chat = meta.get("tokenizer.chat_template")
+    if chat:
+        with open(os.path.join(out_dir, "tokenizer_config.json"), "w") as f:
+            json.dump({"chat_template": chat}, f)
+    return out_dir
+
+
+def resolve_gguf(path: str) -> str:
+    """Serving hook: a `.gguf` model path converts (once, cached next to the
+    file as <name>.hf/) and loads as the converted dir."""
+    out_dir = path + ".hf"
+    marker = os.path.join(out_dir, "config.json")
+    src_mtime = os.path.getmtime(path)
+    if os.path.exists(marker) and os.path.getmtime(marker) >= src_mtime:
+        return out_dir
+    return convert_gguf(path, out_dir)

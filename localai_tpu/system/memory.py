@@ -90,23 +90,27 @@ def estimate(cfg, *, slots: int, context: int, dtype: str = "bfloat16",
              cache_type: str = "", hbm_bytes: int | None = None,
              draft_cfg=None, shards: int = 1,
              kv_shards: int | None = None,
+             kv_pages: int = 0,
              detect_hbm: bool = True) -> MemoryEstimate:
     """PER-CHIP serving-memory estimate for a Llama-family config at the
     given engine shape (reference role: initializers' VRAM guesser guarding
     LoadModel). `shards` divides the weights (the TP 'model' axis — data
     replicas hold full copies); `kv_shards` divides the KV cache (sharded
     over BOTH axes: slots on 'data', kv heads on 'model'; defaults to
-    `shards`)."""
+    `shards`). `kv_pages` > 0 sizes a PAGED cache (ops/paged.py): the pool is
+    kv_pages 128-token blocks shared across slots, so slots × context stops
+    being the dense product."""
     wbytes = int(param_count(cfg) * _DTYPE_BYTES.get(dtype, 2))
     if _DTYPE_BYTES.get(dtype, 2) < 2:
         # quantized weights carry f32 per-channel scales (~1/in_dim overhead)
         wbytes = int(wbytes * 1.02)
 
     kv_elem = 1 if cache_type in ("int8", "q8_0", "q8") else 2
-    kv = (2 * cfg.num_layers * slots * cfg.num_kv_heads * context
+    kv_tokens = kv_pages * 128 if kv_pages > 0 else slots * context
+    kv = (2 * cfg.num_layers * kv_tokens * cfg.num_kv_heads
           * cfg.head_dim * kv_elem)
     if cache_type in ("int8", "q8_0", "q8"):
-        kv += 2 * cfg.num_layers * slots * cfg.num_kv_heads * context * 4
+        kv += 2 * cfg.num_layers * kv_tokens * cfg.num_kv_heads * 4
 
     if draft_cfg is not None:
         wbytes += int(param_count(draft_cfg) * _DTYPE_BYTES.get(dtype, 2))

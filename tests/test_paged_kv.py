@@ -1,0 +1,182 @@
+"""Paged KV cache (ops/paged.py + engine kv_pages): parity with the dense
+cache, block-table kernel indirection, reservation admission, and prefix
+reuse through retained blocks.
+
+Reference role: llama.cpp's unified KV cells across slots
+(/root/reference/backend/cpp/llama-cpp/grpc-server.cpp:311-318); design per
+SURVEY hard-part #1 / PAPERS.md ragged paged attention.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+from fixtures import tiny_checkpoint
+from localai_tpu.engine import Engine, EngineConfig, GenRequest, Tokenizer, load_config, load_params
+from localai_tpu.ops.sampling import SamplingParams
+
+
+@pytest.fixture(scope="module")
+def loaded(tmp_path_factory):
+    ckpt = tiny_checkpoint(tmp_path_factory)
+    cfg = load_config(ckpt, dtype="float32")
+    params = load_params(ckpt, cfg)
+    tok = Tokenizer.from_dir(ckpt)
+    return cfg, params, tok
+
+
+def _collect(eng, reqs):
+    """Run requests through the serving loop; returns {i: [token ids]}."""
+    eng.start()
+    outs = {}
+
+    def run(i, req):
+        rid, q = eng.submit(req)
+        ids = []
+        while True:
+            o = q.get(timeout=120)
+            if o.token_id >= 0:
+                ids.append(o.token_id)
+            if o.finished:
+                outs[i] = (ids, o.finish_reason)
+                return
+
+    ths = [threading.Thread(target=run, args=(i, r))
+           for i, r in enumerate(reqs)]
+    [t.start() for t in ths]
+    [t.join(timeout=240) for t in ths]
+    eng.stop()
+    return outs
+
+
+def _reqs(tok, n=3, max_tokens=24):
+    prompts = ["the quick brown fox", "hello world", "pack my box with"]
+    return [GenRequest(tok.encode(prompts[i % len(prompts)]),
+                       SamplingParams(temperature=0.8, seed=100 + i),
+                       max_tokens=max_tokens, ignore_eos=True)
+            for i in range(n)]
+
+
+@pytest.mark.parametrize("cache_type", ["", "int8"])
+def test_paged_matches_dense(loaded, cache_type):
+    """Same seeds, same prompts → identical token streams paged vs dense."""
+    cfg, params, tok = loaded
+    ec = dict(max_slots=3, max_context=256, prefill_buckets=(32,),
+              cache_type=cache_type, decode_block=4)
+    dense = Engine(cfg, params, tok, EngineConfig(**ec))
+    ref = _collect(dense, _reqs(tok))
+    paged = Engine(cfg, params, tok, EngineConfig(kv_pages=8, **ec))
+    got = _collect(paged, _reqs(tok))
+    assert set(ref) == set(got) == {0, 1, 2}
+    for i in ref:
+        assert got[i] == ref[i], f"request {i} diverged (cache={cache_type})"
+
+
+def test_paged_pallas_interpret_matches_dense(loaded, monkeypatch):
+    """Force the Pallas kernels (interpreter mode on CPU) through the paged
+    table path and compare with the XLA dense reference."""
+    cfg, params, tok = loaded
+    monkeypatch.setenv("LOCALAI_FORCE_PALLAS", "1")
+    ec = dict(max_slots=2, max_context=256, prefill_buckets=(32,),
+              decode_block=4)
+    paged = Engine(cfg, params, tok, EngineConfig(kv_pages=6, **ec))
+    got = _collect(paged, _reqs(tok, n=2, max_tokens=12))
+    monkeypatch.delenv("LOCALAI_FORCE_PALLAS")
+    dense = Engine(cfg, params, tok, EngineConfig(**ec))
+    ref = _collect(dense, _reqs(tok, n=2, max_tokens=12))
+    for i in ref:
+        assert got[i] == ref[i]
+
+
+def test_kernel_table_indirection():
+    """ragged_decode through a shuffled block table == attention over the
+    logically-contiguous cache."""
+    import jax
+    import jax.numpy as jnp
+
+    from localai_tpu.ops.attention import mha_decode
+    from localai_tpu.ops.pallas import ragged_decode
+
+    B, H, KVH, D, BS = 2, 4, 2, 64, 128
+    MAXB, NB = 3, 8
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(B, 1, H, D)), jnp.float32)
+    pool_k = jnp.asarray(rng.normal(size=(NB, KVH, BS, D)), jnp.float32)
+    pool_v = jnp.asarray(rng.normal(size=(NB, KVH, BS, D)), jnp.float32)
+    table = jnp.asarray([[3, 5, 1], [7, 2, 6]], jnp.int32)
+    lengths = jnp.asarray([300, 140], jnp.int32)
+
+    out = ragged_decode(q, pool_k, pool_v, lengths, table=table)
+
+    # reference: gather the virtual view and run the dense XLA decode
+    def view(pool):
+        g = pool[table]                       # [B, MAXB, KVH, BS, D]
+        return g.transpose(0, 2, 1, 3, 4).reshape(B, KVH, MAXB * BS, D)
+
+    ref = mha_decode(q, view(pool_k), view(pool_v), lengths)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_reservation_defers_until_blocks_free(loaded):
+    """A pool too small for two concurrent requests serves them one after the
+    other instead of failing (reservation admission + FIFO deferral)."""
+    cfg, params, tok = loaded
+    # each request: ~4-token prompt + 120 max_tokens + margin ≈ 2 blocks;
+    # pool of 3 (1 trash + 2 usable) fits exactly one at a time
+    eng = Engine(cfg, params, tok, EngineConfig(
+        max_slots=2, max_context=256, prefill_buckets=(32,), kv_pages=3,
+        decode_block=4))
+    reqs = [GenRequest(tok.encode("hi there"),
+                       SamplingParams(temperature=0.0, seed=i),
+                       max_tokens=100, ignore_eos=True) for i in range(2)]
+    outs = _collect(eng, reqs)
+    assert sorted(outs) == [0, 1]
+    for ids, reason in outs.values():
+        assert reason == "length" and len(ids) == 100
+
+
+def test_oversized_request_rejected(loaded):
+    cfg, params, tok = loaded
+    eng = Engine(cfg, params, tok, EngineConfig(
+        max_slots=1, max_context=256, prefill_buckets=(32,), kv_pages=2))
+    with pytest.raises(ValueError, match="KV blocks"):
+        eng.submit(GenRequest(tok.encode("hello"), SamplingParams(),
+                              max_tokens=250))
+
+
+def test_paged_prefix_reuse(loaded):
+    """A released slot's retained blocks serve a shared-prefix follow-up
+    (prompt_cache_hits > 0) and still match a cold engine's output."""
+    cfg, params, tok = loaded
+    long_prefix = "the quick brown fox jumps over the lazy dog " * 4
+    p1 = tok.encode(long_prefix + "first")
+    p2 = tok.encode(long_prefix + "second question")
+    ec = dict(max_slots=2, max_context=256, prefill_buckets=(32,),
+              prompt_cache_min=8, decode_block=4)
+    eng = Engine(cfg, params, tok, EngineConfig(kv_pages=10, **ec))
+    r1 = _collect(eng, [GenRequest(p1, SamplingParams(temperature=0.0),
+                                   max_tokens=8, ignore_eos=True)])
+    eng2 = Engine(cfg, params, tok, EngineConfig(kv_pages=10, **ec))
+    # warm: run p1, then p2 reuses the prefix
+    eng2.start()
+    rid, q = eng2.submit(GenRequest(p1, SamplingParams(temperature=0.0),
+                                    max_tokens=8, ignore_eos=True))
+    while not q.get(timeout=120).finished:
+        pass
+    rid, q = eng2.submit(GenRequest(p2, SamplingParams(temperature=0.0),
+                                    max_tokens=8, ignore_eos=True))
+    warm_ids = []
+    while True:
+        o = q.get(timeout=120)
+        if o.token_id >= 0:
+            warm_ids.append(o.token_id)
+        if o.finished:
+            break
+    eng2.stop()
+    assert eng2.metrics["prompt_cache_hits"] >= 1
+    # cold reference for p2
+    eng3 = Engine(cfg, params, tok, EngineConfig(kv_pages=10, **ec))
+    cold = _collect(eng3, [GenRequest(p2, SamplingParams(temperature=0.0),
+                                      max_tokens=8, ignore_eos=True)])
+    assert warm_ids == cold[0][0]
