@@ -77,6 +77,9 @@ class API:
         r.add_post("/v1/tokenize", self._tokenize)
         r.add_post("/tokenize", self._tokenize)
         r.add_get("/v1/realtime", self._realtime)
+        r.add_post("/v1/realtime/sessions", self._realtime_session)
+        r.add_post("/v1/realtime/transcription_session",
+                   self._realtime_transcription_session)
         r.add_post("/v1/images/generations", self._images)
         r.add_post("/v1/videos", self._videos)
         r.add_post("/video", self._videos)
@@ -726,6 +729,16 @@ class API:
         from localai_tpu.server.realtime import realtime_handler
 
         return await realtime_handler(self, request)
+
+    async def _realtime_session(self, request):
+        from localai_tpu.server.realtime import session_factory_handler
+
+        return await session_factory_handler(self, request, "conversation")
+
+    async def _realtime_transcription_session(self, request):
+        from localai_tpu.server.realtime import session_factory_handler
+
+        return await session_factory_handler(self, request, "transcription")
 
     # ------------------------------------------------------ image endpoints
     # (reference: endpoints/openai/image.go — b64_json/url response shapes)

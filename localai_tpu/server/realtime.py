@@ -3,27 +3,43 @@ LLM → TTS from the model's `pipeline:` config.
 
 Reference: /root/reference/core/http/endpoints/openai/realtime.go:179-1301
 (session state machine :130/:605, audio ring buffer + VAD goroutine :644-858,
-utterance commit → pipeline models, events back over WS :542). This is the
-commit-driven subset of that machine: explicit input_audio_buffer.commit (or
-text conversation items) triggers the pipeline; server-VAD auto-commit mode
-triggers on trailing silence after speech.
+utterance commit → pipeline models, events back over WS :542) and
+routes/openai.go:20-22 (GET /v1/realtime + POST session-factory routes).
+
+Two session intents, as in the reference (realtime.go:67
+"realtime.transcription_session"):
+  conversation   — audio/text in → transcription → LLM → TTS out
+  transcription  — audio in → interim transcription deltas + completed only
 
 Event surface (OpenAI-realtime-shaped):
-  client → server: session.update, conversation.item.create,
+  client → server: session.update, transcription_session.update,
+                   conversation.item.create,
                    input_audio_buffer.append (b64 pcm16 @16 kHz),
-                   input_audio_buffer.commit, response.create
-  server → client: session.created, conversation.item.created,
-                   input_audio_buffer.committed,
+                   input_audio_buffer.commit, input_audio_buffer.clear,
+                   response.create, response.cancel
+  server → client: session.created | transcription_session.created,
+                   session.updated, conversation.item.created,
+                   input_audio_buffer.committed / .cleared,
+                   input_audio_buffer.speech_started / .speech_stopped,
+                   conversation.item.input_audio_transcription.delta,
                    conversation.item.input_audio_transcription.completed,
-                   response.text.delta, response.audio.delta (b64 wav pcm16),
-                   response.done, error
+                   response.created, response.text.delta,
+                   response.audio.delta (b64 wav pcm16), response.done
+                   (status completed|cancelled), error
+
+`response.cancel` genuinely interrupts an in-flight response mid-stream
+(the reference stubs it with NotImplemented, realtime.go:522): the LLM is
+consumed token-by-token via PredictStream and the asyncio task carrying it
+is cancelled, so generation stops being delivered at the next delta.
 """
 from __future__ import annotations
 
 import asyncio
 import base64
 import json
+import secrets
 import tempfile
+import time
 import uuid
 
 import numpy as np
@@ -31,14 +47,19 @@ from aiohttp import WSMsgType, web
 
 
 class RealtimeSession:
-    def __init__(self, api, cfg):
+    def __init__(self, api, cfg, intent: str = "conversation"):
         self.api = api
         self.cfg = cfg                      # ModelConfig with .pipeline
+        self.intent = intent                # "conversation" | "transcription"
         self.messages: list[dict] = []
         self.audio = bytearray()            # pcm16 @16 kHz
         self.session_id = f"sess_{uuid.uuid4().hex[:16]}"
         self.voice = "default"
         self.server_vad = False
+        self.in_speech = False              # VAD state for started/stopped
+        self.response_task: asyncio.Task | None = None
+        self.response_id: str | None = None
+        self.response_done_sent = False
 
     # ---------------------------------------------------------- pipeline ops
 
@@ -72,16 +93,50 @@ class RealtimeSession:
         finally:
             os.unlink(path)
 
-    async def run_llm(self) -> str:
+    async def run_llm_stream(self):
+        """Async-iterate LLM reply chunks via the backend's PredictStream.
+
+        A worker thread drains the gRPC stream into an asyncio queue; the
+        consumer (respond task) may be cancelled between deltas, which stops
+        delivery immediately and abandons the worker to finish into a dead
+        queue.
+        """
         name = self.cfg.pipeline.llm or self.cfg.name
         handle = await self._handle_for(name)
         mcfg = self.api.configs.get(name) or self.cfg
         opts = self.api._merged_options(mcfg, {})
         opts["messages_json"] = json.dumps(self.messages)
         opts["use_tokenizer_template"] = True
-        reply = await asyncio.to_thread(
-            lambda: handle.client.predict(**opts))
-        return reply.message.decode("utf-8", "replace")
+
+        loop = asyncio.get_running_loop()
+        q: asyncio.Queue = asyncio.Queue()
+        DONE = object()
+        call = handle.client.predict_stream(**opts)   # gRPC stream handle
+
+        def worker():
+            try:
+                for reply in call:
+                    loop.call_soon_threadsafe(
+                        q.put_nowait, reply.message.decode("utf-8", "replace"))
+                loop.call_soon_threadsafe(q.put_nowait, DONE)
+            except Exception as e:  # surfaced as an error event by respond()
+                loop.call_soon_threadsafe(q.put_nowait, e)
+
+        threading_task = asyncio.create_task(asyncio.to_thread(worker))
+        try:
+            while True:
+                item = await q.get()
+                if item is DONE:
+                    break
+                if isinstance(item, Exception):
+                    raise item
+                yield item
+        finally:
+            # cancel the gRPC stream so the BACKEND stops generating — a
+            # thread cancel alone would let the engine run to max_tokens
+            # into a dead queue
+            call.cancel()
+            threading_task.cancel()
 
     async def run_tts(self, text: str) -> bytes:
         name = self.cfg.pipeline.tts
@@ -100,63 +155,157 @@ class RealtimeSession:
         finally:
             os.unlink(path)
 
-    def vad_has_utterance(self) -> bool:
-        """Server-VAD: speech followed by >=300 ms of silence."""
+    def vad_state(self) -> tuple[bool, bool]:
+        """One detect_segments pass over the buffer → (speech_present,
+        utterance_complete: speech followed by >=300 ms of silence)."""
         from localai_tpu.audio.pcm import i16_to_f32
         from localai_tpu.audio.vad import detect_segments
 
         pcm = i16_to_f32(np.frombuffer(bytes(self.audio), np.int16))
-        if len(pcm) < 16000 // 2:
-            return False
+        if len(pcm) < 16000 // 4:
+            return False, False
         segs = detect_segments(pcm)
         if not segs:
-            return False
-        return (len(pcm) / 16000.0 - segs[-1][1]) >= 0.3
+            return False, False
+        done = (len(pcm) >= 16000 // 2
+                and (len(pcm) / 16000.0 - segs[-1][1]) >= 0.3)
+        return True, done
+
+
+def _session_payload(sess: RealtimeSession, model: str) -> dict:
+    """Session object shape shared by WS created events and the POST
+    session-factory routes (reference: RealtimeTranscriptionSession,
+    routes/openai.go:21-22). client_secret is the ephemeral-key surface."""
+    return {
+        "id": sess.session_id,
+        "object": ("realtime.transcription_session"
+                   if sess.intent == "transcription" else "realtime.session"),
+        "model": model,
+        "intent": sess.intent,
+        "voice": sess.voice,
+        "client_secret": {
+            "value": f"ek_{secrets.token_hex(16)}",
+            "expires_at": int(time.time()) + 600,
+        },
+    }
+
+
+async def session_factory_handler(api, request: web.Request,
+                                  intent: str = "conversation"):
+    """POST /v1/realtime/sessions and /v1/realtime/transcription_session —
+    mint an ephemeral session descriptor (reference routes/openai.go:21-22)."""
+    try:
+        body = await request.json()
+    except Exception:
+        body = {}
+    name = body.get("model", "")
+    cfg = api.configs.get(name) if name else api.configs.first()
+    if cfg is None:
+        raise web.HTTPNotFound(text="no model for realtime session")
+    sess = RealtimeSession(api, cfg, intent=intent)
+    if isinstance(body.get("voice"), str):
+        sess.voice = body["voice"]
+    return web.json_response(_session_payload(sess, cfg.name))
 
 
 async def realtime_handler(api, request: web.Request):
     name = request.query.get("model", "")
+    intent = request.query.get("intent", "conversation")
+    if intent not in ("conversation", "transcription"):
+        raise web.HTTPBadRequest(text=f"unknown intent {intent!r}")
     cfg = api.configs.get(name) if name else api.configs.first()
     if cfg is None:
         raise web.HTTPNotFound(text="no model for realtime session")
 
     ws = web.WebSocketResponse()
     await ws.prepare(request)
-    sess = RealtimeSession(api, cfg)
+    sess = RealtimeSession(api, cfg, intent=intent)
+
+    send_lock = asyncio.Lock()
 
     async def send(obj):
-        await ws.send_json(obj)
+        # the respond() task and the message loop both write to the socket
+        async with send_lock:
+            await ws.send_json(obj)
 
-    await send({"type": "session.created",
-                "session": {"id": sess.session_id, "model": cfg.name}})
+    created = ("transcription_session.created"
+               if intent == "transcription" else "session.created")
+    await send({"type": created,
+                "session": _session_payload(sess, cfg.name)})
+
+    async def transcribe_committed():
+        """Shared commit path: emit committed + transcription events, append
+        the user message (conversation intent only). Returns the text."""
+        await send({"type": "input_audio_buffer.committed"})
+        text = await sess.transcribe_buffer()
+        sess.audio.clear()
+        sess.in_speech = False
+        if text:
+            # interim delta(s) then completed — the reference's Python
+            # transcription backends emit segment deltas the same way
+            for word in _delta_chunks(text):
+                await send({
+                    "type": "conversation.item.input_audio_transcription.delta",
+                    "delta": word})
+            await send({
+                "type":
+                    "conversation.item.input_audio_transcription.completed",
+                "transcript": text})
+            if sess.intent == "conversation":
+                sess.messages.append({"role": "user", "content": text})
+        return text
 
     async def commit_and_respond():
         if sess.audio:
-            await send({"type": "input_audio_buffer.committed"})
-            text = await sess.transcribe_buffer()
-            sess.audio.clear()
-            if text:
-                await send({
-                    "type": "conversation.item.input_audio_transcription.completed",
-                    "transcript": text})
-                sess.messages.append({"role": "user", "content": text})
-        await respond()
+            await transcribe_committed()
+        if sess.intent == "conversation":
+            start_response()
 
-    async def respond():
+    def start_response():
+        if sess.response_task is not None and not sess.response_task.done():
+            return  # one active response at a time, as in the reference
+        sess.response_id = f"resp_{uuid.uuid4().hex[:12]}"
+        sess.response_done_sent = False
+        sess.response_task = asyncio.create_task(respond(sess.response_id))
+
+    async def respond(rid: str):
         if not sess.messages:
             await send({"type": "error",
                         "error": {"message": "no conversation items"}})
             return
-        text = await sess.run_llm()
-        rid = f"resp_{uuid.uuid4().hex[:12]}"
-        await send({"type": "response.text.delta", "response_id": rid,
-                    "delta": text})
-        sess.messages.append({"role": "assistant", "content": text})
-        audio = await sess.run_tts(text)
-        if audio:
-            await send({"type": "response.audio.delta", "response_id": rid,
-                        "delta": base64.b64encode(audio).decode()})
-        await send({"type": "response.done", "response_id": rid})
+        await send({"type": "response.created", "response_id": rid})
+        parts: list[str] = []
+        appended = False
+        try:
+            async for delta in sess.run_llm_stream():
+                parts.append(delta)
+                await send({"type": "response.text.delta",
+                            "response_id": rid, "delta": delta})
+            text = "".join(parts)
+            sess.messages.append({"role": "assistant", "content": text})
+            appended = True
+            audio = await sess.run_tts(text)
+            if audio:
+                await send({"type": "response.audio.delta",
+                            "response_id": rid,
+                            "delta": base64.b64encode(audio).decode()})
+            sess.response_done_sent = True
+            await send({"type": "response.done", "response_id": rid,
+                        "status": "completed"})
+        except asyncio.CancelledError:
+            # partial text is still conversation state, as with a user
+            # interrupting a voice assistant mid-sentence (unless the full
+            # reply was already appended and the cancel landed in TTS)
+            if parts and not appended:
+                sess.messages.append(
+                    {"role": "assistant", "content": "".join(parts)})
+            sess.response_done_sent = True
+            await send({"type": "response.done", "response_id": rid,
+                        "status": "cancelled"})
+            raise
+        except Exception as e:
+            await send({"type": "error",
+                        "error": {"message": f"{type(e).__name__}: {e}"}})
 
     async for msg in ws:
         if msg.type != WSMsgType.TEXT:
@@ -169,7 +318,7 @@ async def realtime_handler(api, request: web.Request):
             continue
         t = ev.get("type")
         try:
-            if t == "session.update":
+            if t in ("session.update", "transcription_session.update"):
                 s = ev.get("session", {})
                 sess.voice = s.get("voice", sess.voice)
                 td = s.get("turn_detection")
@@ -185,16 +334,61 @@ async def realtime_handler(api, request: web.Request):
                 await send({"type": "conversation.item.created"})
             elif t == "input_audio_buffer.append":
                 sess.audio.extend(base64.b64decode(ev.get("audio", "")))
-                if sess.server_vad and sess.vad_has_utterance():
-                    await commit_and_respond()
+                if sess.server_vad:
+                    present, done = sess.vad_state()
+                    if not sess.in_speech and present:
+                        sess.in_speech = True
+                        await send(
+                            {"type": "input_audio_buffer.speech_started"})
+                    if done:
+                        if sess.in_speech:
+                            await send(
+                                {"type":
+                                 "input_audio_buffer.speech_stopped"})
+                        await commit_and_respond()
             elif t == "input_audio_buffer.commit":
                 await commit_and_respond()
+            elif t == "input_audio_buffer.clear":
+                sess.audio.clear()
+                sess.in_speech = False
+                await send({"type": "input_audio_buffer.cleared"})
             elif t == "response.create":
-                await respond()
+                if sess.intent == "transcription":
+                    await send({"type": "error", "error": {
+                        "message": "transcription session has no responses"}})
+                else:
+                    start_response()
+            elif t == "response.cancel":
+                task = sess.response_task
+                if task is not None and not task.done():
+                    task.cancel()
+                    try:
+                        await task
+                    except asyncio.CancelledError:
+                        pass
+                    if not sess.response_done_sent:
+                        # cancel landed before the task's coroutine ever
+                        # ran — its own cancelled-handler never fired
+                        sess.response_done_sent = True
+                        await send({"type": "response.done",
+                                    "response_id": sess.response_id,
+                                    "status": "cancelled"})
+                else:
+                    await send({"type": "error", "error": {
+                        "message": "no active response to cancel"}})
             else:
                 await send({"type": "error",
                             "error": {"message": f"unknown event {t!r}"}})
         except Exception as e:
             await send({"type": "error",
                         "error": {"message": f"{type(e).__name__}: {e}"}})
+    if sess.response_task is not None and not sess.response_task.done():
+        sess.response_task.cancel()
     return ws
+
+
+def _delta_chunks(text: str, n: int = 4) -> list[str]:
+    """Split a transcript into word-group deltas for interim events."""
+    words = text.split(" ")
+    return [" ".join(words[i:i + n]) + (" " if i + n < len(words) else "")
+            for i in range(0, len(words), n)]

@@ -38,6 +38,29 @@ def stack(tmp_path_factory):
 
     ckpt = tiny_checkpoint(tmp_path_factory)
     models = tmp_path_factory.mktemp("models")
+
+    # tiny whisper for the realtime transcription pipeline
+    import torch
+    from transformers import WhisperConfig, WhisperForConditionalGeneration
+
+    wdir = str(tmp_path_factory.mktemp("whisper-ckpt"))
+    torch.manual_seed(0)
+    wcfg = WhisperConfig(
+        vocab_size=51865, d_model=64, encoder_layers=2, decoder_layers=2,
+        encoder_attention_heads=4, decoder_attention_heads=4,
+        encoder_ffn_dim=128, decoder_ffn_dim=128, num_mel_bins=80,
+        max_source_positions=1500, max_target_positions=64)
+    wm = WhisperForConditionalGeneration(wcfg)
+    wm.generation_config.forced_decoder_ids = None
+    wm.generation_config.suppress_tokens = None
+    wm.generation_config.begin_suppress_tokens = None
+    wm.save_pretrained(wdir, safe_serialization=True)
+    (models / "whisper-tiny.yaml").write_text(yaml.safe_dump({
+        "name": "whisper-tiny",
+        "backend": "whisper",
+        "parameters": {"model": wdir},
+    }))
+
     (models / "tiny.yaml").write_text(yaml.safe_dump({
         "name": "tiny",
         "backend": "llm",
@@ -54,6 +77,7 @@ def stack(tmp_path_factory):
         "pipeline": {
             "llm": "tiny",
             "tts": "default-tts",
+            "transcription": "whisper-tiny",
         },
     }))
 
@@ -93,7 +117,8 @@ def test_models_list(stack):
     base, _ = stack
     r = requests.get(base + "/v1/models", timeout=10)
     assert r.status_code == 200
-    assert [m["id"] for m in r.json()["data"]] == ["tiny"]
+    assert sorted(m["id"] for m in r.json()["data"]) == ["tiny",
+                                                         "whisper-tiny"]
 
 
 def test_chat_nonstream(stack):
@@ -390,14 +415,16 @@ def test_realtime_websocket_text_session(stack):
 
         ws.send(json.dumps({"type": "response.create"}))
         events = {}
-        for _ in range(4):
+        for _ in range(64):
             ev = json.loads(ws.recv(timeout=600))
             events[ev["type"]] = ev
             if ev["type"] == "response.done":
                 break
+        assert "response.created" in events
         assert "response.text.delta" in events
         assert "response.audio.delta" in events
         assert "response.done" in events
+        assert events["response.done"]["status"] == "completed"
         wav_bytes = base64.b64decode(events["response.audio.delta"]["delta"])
         with wave.open(io.BytesIO(wav_bytes)) as w:
             assert w.getnframes() > 0
@@ -405,6 +432,104 @@ def test_realtime_websocket_text_session(stack):
         # unknown event type surfaces an error event, session stays alive
         ws.send(json.dumps({"type": "bogus.event"}))
         assert json.loads(ws.recv(timeout=30))["type"] == "error"
+
+
+def test_realtime_response_cancel(stack):
+    """response.cancel interrupts an in-flight response: the terminal event
+    is response.done with status cancelled (the reference stubs this,
+    realtime.go:522 — we implement it)."""
+    from websockets.sync.client import connect
+
+    base, _ = stack
+    url = base.replace("http://", "ws://") + "/v1/realtime?model=tiny"
+    with connect(url, open_timeout=30) as ws:
+        assert json.loads(ws.recv(timeout=30))["type"] == "session.created"
+        ws.send(json.dumps({"type": "conversation.item.create",
+                            "item": {"role": "user", "content": "hi"}}))
+        assert json.loads(ws.recv(timeout=30))["type"] == \
+            "conversation.item.created"
+        ws.send(json.dumps({"type": "response.create"}))
+        ws.send(json.dumps({"type": "response.cancel"}))
+        status = None
+        for _ in range(64):
+            ev = json.loads(ws.recv(timeout=600))
+            if ev["type"] == "response.done":
+                status = ev["status"]
+                break
+            assert ev["type"] in ("response.created", "response.text.delta",
+                                  "response.audio.delta", "error")
+        # cancelled when the cancel landed mid-flight; completed only if the
+        # tiny model outran the cancel — either way done is terminal
+        assert status in ("cancelled", "completed")
+
+        # cancel with nothing active is an error event
+        ws.send(json.dumps({"type": "response.cancel"}))
+        assert json.loads(ws.recv(timeout=30))["type"] == "error"
+
+
+def test_realtime_transcription_session(stack):
+    """intent=transcription sessions (reference routes/openai.go:21-22,
+    realtime.go:67): audio commit yields transcription delta + completed and
+    NO response events; response.create is rejected; buffer.clear works."""
+    import base64
+
+    from websockets.sync.client import connect
+
+    from localai_tpu.audio.tts import synthesize
+
+    base, _ = stack
+    url = (base.replace("http://", "ws://")
+           + "/v1/realtime?model=tiny&intent=transcription")
+    with connect(url, open_timeout=30) as ws:
+        first = json.loads(ws.recv(timeout=30))
+        assert first["type"] == "transcription_session.created"
+        assert first["session"]["object"] == "realtime.transcription_session"
+
+        # clear path
+        ws.send(json.dumps({"type": "input_audio_buffer.append",
+                            "audio": base64.b64encode(b"\0\0" * 160).decode()}))
+        ws.send(json.dumps({"type": "input_audio_buffer.clear"}))
+        assert json.loads(ws.recv(timeout=30))["type"] == \
+            "input_audio_buffer.cleared"
+
+        # commit synthesized speech → transcription events only
+        pcm = synthesize("hello there how are you", voice="default",
+                         language="en")
+        i16 = (np.clip(pcm, -1, 1) * 32767).astype(np.int16).tobytes()
+        ws.send(json.dumps({"type": "input_audio_buffer.append",
+                            "audio": base64.b64encode(i16).decode()}))
+        ws.send(json.dumps({"type": "input_audio_buffer.commit"}))
+        got = []
+        for _ in range(64):
+            ev = json.loads(ws.recv(timeout=600))
+            got.append(ev["type"])
+            if ev["type"] == \
+                    "conversation.item.input_audio_transcription.completed":
+                break
+        assert "input_audio_buffer.committed" in got
+        assert not any(t.startswith("response.") for t in got)
+
+        # responses are a conversation-session concept
+        ws.send(json.dumps({"type": "response.create"}))
+        assert json.loads(ws.recv(timeout=30))["type"] == "error"
+
+
+def test_realtime_session_factory_routes(stack):
+    """POST /v1/realtime/sessions + /v1/realtime/transcription_session mint
+    ephemeral session descriptors (reference routes/openai.go:21-22)."""
+    base, _ = stack
+    r = requests.post(base + "/v1/realtime/sessions",
+                      json={"model": "tiny", "voice": "alto"}, timeout=30)
+    assert r.status_code == 200
+    s = r.json()
+    assert s["object"] == "realtime.session"
+    assert s["model"] == "tiny" and s["voice"] == "alto"
+    assert s["client_secret"]["value"].startswith("ek_")
+
+    r = requests.post(base + "/v1/realtime/transcription_session",
+                      json={}, timeout=30)
+    assert r.status_code == 200
+    assert r.json()["object"] == "realtime.transcription_session"
 
 
 def test_kill9_backend_recovers(stack):
