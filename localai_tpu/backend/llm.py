@@ -93,11 +93,10 @@ class LLMServicer(BackendServicer):
             mesh = build_mesh(MeshConfig(data=data, model=model),
                               devices[: data * model])
         elif (len(devices) > 1
-              and request.dtype not in ("int8", "q8", "int4", "q4")
-              and not request.draft_model):
+              and request.dtype not in ("int8", "q8", "int4", "q4")):
             # auto-TP over as many devices as the model dims divide into
-            # (draft-model serving is single-device for now — the engine
-            # rejects a draft under a mesh)
+            # (a draft model rides the mesh too — sharded when its dims
+            # divide the axis, replicated otherwise)
             model = max_model_axis(cfg, len(devices))
             if model > 1:
                 mesh = build_mesh(MeshConfig(data=1, model=model),
@@ -154,8 +153,17 @@ class LLMServicer(BackendServicer):
         draft = None
         if dcfg is not None:
             # speculative decoding (reference DraftModel, backend.proto:218)
+            dspecs = None
+            if mesh is not None:
+                from localai_tpu.models.llama import replicated_specs
+
+                model_ax = int(dict(zip(
+                    mesh.axis_names, mesh.devices.shape)).get("model", 1))
+                if max_model_axis(dcfg, model_ax) != model_ax:
+                    dspecs = replicated_specs(dcfg)
             draft = (dcfg, load_params(draft_dir, dcfg,
-                                       dtype=request.dtype or None))
+                                       dtype=request.dtype or None,
+                                       mesh=mesh, specs=dspecs))
         # one storage kind for both K and V (quantize when either side asks;
         # the reference allows split k/v types — grpc-server.cpp:236-251)
         cache_type = kv_kind

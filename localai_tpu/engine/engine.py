@@ -95,6 +95,12 @@ class EngineConfig:
                                   # reserve ceil((prompt+max_tokens)/128)
                                   # blocks at admission, so the pool
                                   # oversubscribes max_context, not requests.
+    max_restarts: int = 2         # fatal step() errors survived per engine
+                                  # lifetime: in-flight streams fail, device
+                                  # state is rebuilt, new requests serve
+                                  # (reference analog: the manager reaping +
+                                  # respawning a dead backend — this recovers
+                                  # WITHOUT losing the loaded weights)
 
 
 @dataclasses.dataclass
@@ -171,9 +177,6 @@ class Engine:
         self.tok = tokenizer
         self.ec = econfig or EngineConfig()
         self._draft = draft
-        if draft is not None and self.ec.mesh is not None:
-            raise NotImplementedError(
-                "draft model under a mesh is not supported yet")
         if self.ec.max_context > cfg.max_position:
             raise ValueError("max_context exceeds model max_position")
         for b in self.ec.prefill_buckets:
@@ -183,6 +186,12 @@ class Engine:
         B, T, V = self.ec.max_slots, self.ec.max_context, cfg.vocab_size
         dtype = jnp.dtype(self.ec.dtype) if self.ec.dtype else cfg.jdtype
         self.mesh = self.ec.mesh
+        # single-process meshes (one host driving all chips) keep every
+        # shard addressable: the disk prompt cache can slice/inject KV
+        # host-side. Multi-host meshes can't (rank 0 host code isn't
+        # replayed on followers), so the cache stays off there.
+        self._cache_addressable = (self.mesh is None
+                                   or jax.process_count() == 1)
 
         if (jax.default_backend() == "tpu" and self.mesh is None
                 and os.environ.get("LOCALAI_NO_PALLAS") != "1"
@@ -203,64 +212,23 @@ class Engine:
 
         # paged KV (ops/paged.py): block pool + per-slot tables instead of a
         # dense [B, T] product. Host owns allocation; the device sees a
-        # [B, MAXB] table per dispatch. Incompatible (v1) with meshes,
-        # speculative drafts, context-shift and the disk prompt cache.
+        # [B, MAXB] table per dispatch. Under a mesh the pool rides the XLA
+        # gather path — block axis replicated, KV heads sharded on 'model'.
+        # Incompatible (v1) with speculative drafts, context-shift and the
+        # disk prompt cache.
         self._paged = self.ec.kv_pages > 0
         if self._paged:
-            from localai_tpu.ops.paged import BLOCK
-
-            if self.mesh is not None:
-                raise NotImplementedError("paged KV under a mesh")
             if draft is not None:
                 raise NotImplementedError("paged KV with a draft model")
             if self.ec.kv_pages < 2:
                 raise ValueError("kv_pages must be >= 2 (block 0 is trash)")
-            self._maxb = -(-T // BLOCK)
-            self._table = np.zeros((B, self._maxb), np.int32)
-            self._kv_free: list[int] = list(range(1, self.ec.kv_pages))
-            self._slot_blocks: list[list[int]] = [[] for _ in range(B)]
-            self._released_lru: list[int] = []
-        self._deferred: tuple | None = None   # admission waiting on blocks
-        self._blocks_freed = False
-
-        with activate_mesh(self.mesh):
-            cos, sin = rope_table(cfg.rope, T)
-            self._cos, self._sin = cos, sin
-            if self._paged:
-                from localai_tpu.ops.paged import init_paged
-
-                self._kc, self._vc = init_paged(
-                    cfg.num_layers, self.ec.kv_pages, cfg.num_kv_heads,
-                    cfg.head_dim, dtype, cache_type=self.ec.cache_type)
-            else:
-                self._kc, self._vc = init_kv_cache(
-                    cfg, B, T, dtype, cache_type=self.ec.cache_type)
-            self._sampler = SamplerState.init(B, V)
-            self._last_logits = jnp.zeros((B, V), jnp.float32)
-            self._lengths = jnp.zeros((B,), jnp.int32)
-            if self._draft is not None:
-                dcfg = self._draft[0]
-                if dcfg.vocab_size != V:
-                    raise ValueError("draft vocab differs from target")
-                self._cos_d, self._sin_d = rope_table(dcfg.rope, T)
-                self._kcd, self._vcd = init_kv_cache(dcfg, B, T, dtype)
-                self._next_tokens = jnp.zeros((B,), jnp.int32)
+        if self._draft is not None and self._draft[0].vocab_size != V:
+            raise ValueError("draft vocab differs from target")
+        self._kv_dtype = dtype
+        self._init_device_state()
         # window the verify extend writes ahead of `lengths`; reserve it so
         # a spec step can never write past the cache end
         self._ctx_reserve = (self.ec.gamma + 1) if self._draft else 0
-
-        # grammar masks: one bitmask row per slot, all-ones = unconstrained
-        self._mask_nbytes = (V + 7) // 8
-        self._mask_host = np.full((B, self._mask_nbytes), 0xFF, np.uint8)
-        self._grammar_slots = 0
-        self._grammar_cache = None
-
-        # host-side slot table
-        self._slots: list[_Slot | None] = [None] * B
-        self._free: list[int] = list(range(B))
-        # prompt cache: per slot, the token ids whose K/V rows are still
-        # valid in that slot's cache region (recorded at release)
-        self._slot_kv_tokens: list[list[int]] = [[] for _ in range(B)]
         # chunked prefill: chunk window + the buckets small enough to prefill
         # single-shot without stalling running decodes longer than one chunk
         if self.ec.prefill_chunk < 8:
@@ -305,6 +273,60 @@ class Engine:
             self.metrics["draft_accepted"] = 0
 
         self._build_jit()
+
+    def _init_device_state(self):
+        """(Re)create all device-held serving state: KV caches, sampler,
+        logits, lengths, paged tables, grammar masks, host slot table.
+        Called at construction and again by the loop's self-restart path —
+        params are never donated, so a fresh state block is all a recovery
+        needs after a fatal device error."""
+        cfg, B, T = self.cfg, self.ec.max_slots, self.ec.max_context
+        V, dtype = cfg.vocab_size, self._kv_dtype
+        if self._paged:
+            from localai_tpu.ops.paged import BLOCK
+
+            self._maxb = -(-T // BLOCK)
+            self._table = np.zeros((B, self._maxb), np.int32)
+            self._kv_free: list[int] = list(range(1, self.ec.kv_pages))
+            self._slot_blocks: list[list[int]] = [[] for _ in range(B)]
+            self._released_lru: list[int] = []
+        self._deferred: tuple | None = None   # admission waiting on blocks
+        self._admitting: tuple | None = None  # admission mid-device-call
+        self._blocks_freed = False
+
+        with activate_mesh(self.mesh):
+            cos, sin = rope_table(cfg.rope, T)
+            self._cos, self._sin = cos, sin
+            if self._paged:
+                from localai_tpu.ops.paged import init_paged
+
+                self._kc, self._vc = init_paged(
+                    cfg.num_layers, self.ec.kv_pages, cfg.num_kv_heads,
+                    cfg.head_dim, dtype, cache_type=self.ec.cache_type)
+            else:
+                self._kc, self._vc = init_kv_cache(
+                    cfg, B, T, dtype, cache_type=self.ec.cache_type)
+            self._sampler = SamplerState.init(B, V)
+            self._last_logits = jnp.zeros((B, V), jnp.float32)
+            self._lengths = jnp.zeros((B,), jnp.int32)
+            if self._draft is not None:
+                dcfg = self._draft[0]
+                self._cos_d, self._sin_d = rope_table(dcfg.rope, T)
+                self._kcd, self._vcd = init_kv_cache(dcfg, B, T, dtype)
+                self._next_tokens = jnp.zeros((B,), jnp.int32)
+
+        # grammar masks: one bitmask row per slot, all-ones = unconstrained
+        self._mask_nbytes = (V + 7) // 8
+        self._mask_host = np.full((B, self._mask_nbytes), 0xFF, np.uint8)
+        self._grammar_slots = 0
+        self._grammar_cache = None
+
+        # host-side slot table
+        self._slots: list[_Slot | None] = [None] * B
+        self._free: list[int] = list(range(B))
+        # prompt cache: per slot, the token ids whose K/V rows are still
+        # valid in that slot's cache region (recorded at release)
+        self._slot_kv_tokens: list[list[int]] = [[] for _ in range(B)]
 
     # ------------------------------------------------------------ jit builds
 
@@ -419,9 +441,22 @@ class Engine:
             )
 
             dcfg = self._draft[0]
+            _spec_raw = build_spec_decode(cfg, dcfg, self.ec.gamma)
+
+            def _spec(*a):
+                # host (rank 0) reads the small per-step outputs each spec
+                # step — replicate them, as with _decode above
+                (tokens_out, n_out, logprobs_out, next_tokens, kct, vct,
+                 kcd, vcd, sampler, lengths, n_extra) = _spec_raw(*a)
+                return (constrain(tokens_out, P(None)),
+                        constrain(n_out, P(None)),
+                        constrain(logprobs_out, P(None)),
+                        constrain(next_tokens, P(None)),
+                        kct, vct, kcd, vcd, sampler, lengths,
+                        constrain(n_extra, P(None)))
+
             self._spec_fn = jax.jit(
-                build_spec_decode(cfg, dcfg, self.ec.gamma),
-                donate_argnums=(6, 7, 8, 9, 10, 11, 12))
+                _spec, donate_argnums=(6, 7, 8, 9, 10, 11, 12))
             self._spec_admit_tail_fn = jax.jit(
                 build_spec_admit_tail(cfg), donate_argnums=(0,))
             self._draft_ingest_fn = jax.jit(
@@ -577,26 +612,29 @@ class Engine:
 
     def _dev_draft_ingest(self, buf, pos, idx):
         self._bcast("draft_ingest", buf=buf, pos=pos, idx=idx)
-        self._kcd, self._vcd = self._draft_ingest_fn(
-            self._draft[1], self._cos_d, self._sin_d, self._kcd, self._vcd,
-            jnp.asarray(buf), jnp.int32(pos), jnp.int32(idx))
+        with activate_mesh(self.mesh):
+            self._kcd, self._vcd = self._draft_ingest_fn(
+                self._draft[1], self._cos_d, self._sin_d, self._kcd,
+                self._vcd, jnp.asarray(buf), jnp.int32(pos), jnp.int32(idx))
 
     def _dev_spec_admit_tail(self, idx):
         self._bcast("spec_admit_tail", idx=idx)
-        tok, lp, self._sampler = self._spec_admit_tail_fn(
-            self._sampler, self._last_logits, jnp.int32(idx))
-        self._next_tokens = self._next_tokens.at[idx].set(tok)
+        with activate_mesh(self.mesh):
+            tok, lp, self._sampler = self._spec_admit_tail_fn(
+                self._sampler, self._last_logits, jnp.int32(idx))
+            self._next_tokens = self._next_tokens.at[idx].set(tok)
         return int(tok), float(lp)
 
     def _dev_spec_decode(self, active):
         self._bcast("spec", active=active)
-        (tokens_out, n_out, logprobs_out, self._next_tokens,
-         self._kc, self._vc, self._kcd, self._vcd, self._sampler,
-         self._lengths, n_extra) = self._spec_fn(
-            self.params, self._draft[1], self._cos, self._sin,
-            self._cos_d, self._sin_d, self._kc, self._vc,
-            self._kcd, self._vcd, self._sampler, self._lengths,
-            self._next_tokens, jnp.asarray(active))
+        with activate_mesh(self.mesh):
+            (tokens_out, n_out, logprobs_out, self._next_tokens,
+             self._kc, self._vc, self._kcd, self._vcd, self._sampler,
+             self._lengths, n_extra) = self._spec_fn(
+                self.params, self._draft[1], self._cos, self._sin,
+                self._cos_d, self._sin_d, self._kc, self._vc,
+                self._kcd, self._vcd, self._sampler, self._lengths,
+                self._next_tokens, jnp.asarray(active))
         return tokens_out, n_out, logprobs_out, n_extra
 
     def follow(self, channel) -> None:
@@ -611,28 +649,43 @@ class Engine:
                 return
             if op == "stop":
                 return
-            if op == "admit":
-                self._dev_admit(kw["ids"], kw["n"], kw["slot"], kw["row"],
-                                kw["counts_row"])
-            elif op == "extend_mid":
-                self._dev_extend_mid(kw["buf"], kw["pos"], kw["idx"])
-            elif op == "extend_final":
-                self._dev_extend_final(kw["buf"], kw["pos"], kw["nvalid"],
-                                       kw["idx"], kw["row"], kw["counts_row"])
-            elif op == "decode":
-                self._dev_decode(kw["active"], kw["mask"],
-                                 kw.get("fast_width"))
-            elif op == "decode_block":
-                self._dev_decode_block(kw["active"], int(kw["steps"]),
-                                       kw.get("fast_width"), kw.get("mask"))
-            elif op == "shift":
-                self._dev_shift(kw["idx"])
-            elif op == "draft_ingest":
-                self._dev_draft_ingest(kw["buf"], kw["pos"], kw["idx"])
-            elif op == "spec_admit_tail":
-                self._dev_spec_admit_tail(kw["idx"])
-            elif op == "spec":
-                self._dev_spec_decode(kw["active"])
+            try:
+                self._follow_op(op, kw)
+            except Exception:
+                # the same fatal device error rank 0 just hit: survive it so
+                # the upcoming 'reset' replay can rebuild this rank's state —
+                # dying here would leave rank 0's restart hanging on
+                # collectives this rank never joins
+                import traceback
+
+                traceback.print_exc()
+
+    def _follow_op(self, op: str, kw: dict) -> None:
+        if op == "admit":
+            self._dev_admit(kw["ids"], kw["n"], kw["slot"], kw["row"],
+                            kw["counts_row"])
+        elif op == "extend_mid":
+            self._dev_extend_mid(kw["buf"], kw["pos"], kw["idx"])
+        elif op == "extend_final":
+            self._dev_extend_final(kw["buf"], kw["pos"], kw["nvalid"],
+                                   kw["idx"], kw["row"], kw["counts_row"])
+        elif op == "decode":
+            self._dev_decode(kw["active"], kw["mask"],
+                             kw.get("fast_width"))
+        elif op == "decode_block":
+            self._dev_decode_block(kw["active"], int(kw["steps"]),
+                                   kw.get("fast_width"), kw.get("mask"))
+        elif op == "shift":
+            self._dev_shift(kw["idx"])
+        elif op == "draft_ingest":
+            self._dev_draft_ingest(kw["buf"], kw["pos"], kw["idx"])
+        elif op == "spec_admit_tail":
+            self._dev_spec_admit_tail(kw["idx"])
+        elif op == "spec":
+            self._dev_spec_decode(kw["active"])
+        elif op == "reset":
+            # rank 0 is self-restarting after a fatal step error
+            self._init_device_state()
 
     # ------------------------------------------------------------ submission
 
@@ -846,7 +899,13 @@ class Engine:
                     rid, req, out = self._queue.get_nowait()
                 except queue.Empty:
                     return
-            if self._admit_one(rid, req, out) is None:
+            # keep the popped triple reachable while the device call runs:
+            # if admission dies mid-flight, _fail_active must still
+            # terminate this stream (it is in neither _queue nor _slots)
+            self._admitting = (rid, req, out)
+            ok = self._admit_one(rid, req, out)
+            self._admitting = None
+            if ok is None:
                 return
 
     def _active_mask(self) -> np.ndarray:
@@ -1233,7 +1292,8 @@ class Engine:
     def _load_prompt_cache(self, slot: int, req: GenRequest) -> int:
         """Restore a saved KV prefix into `slot` if the file's tokens prefix
         this prompt. Returns the reusable length (0 = cold)."""
-        if self.mesh is not None or self._draft is not None or self._paged:
+        if (not self._cache_addressable or self._draft is not None
+                or self._paged):
             return 0
         try:
             with np.load(req.prompt_cache_path, allow_pickle=False) as z:
@@ -1278,7 +1338,7 @@ class Engine:
         """Persist the slot's prompt-KV rows + token ids to the request's
         cache file (skipped for RO requests, meshes, shifted slots)."""
         if (not slot.req.prompt_cache_path or slot.req.prompt_cache_ro
-                or self.mesh is not None or self._draft is not None
+                or not self._cache_addressable or self._draft is not None
                 or self._paged or slot.shifted or not slot.prefilled):
             return
         n = min(slot.prompt_len, self.ec.max_context - 2)
@@ -1390,12 +1450,23 @@ class Engine:
         so no consumer blocks forever on its output queue."""
         self._pending = None
         self._prefillq.clear()
+        failed_rids = set()
+        for slot in self._slots:
+            if slot is not None:
+                failed_rids.add(slot.request_id)
         if self._deferred is not None:
             rid, req, out = self._deferred
             self._deferred = None
             out.put(StepOutput(request_id=rid, text="", token_id=-1,
                                logprob=0.0, finished=True,
                                finish_reason=reason))
+        if self._admitting is not None:
+            rid, req, out = self._admitting
+            self._admitting = None
+            if rid not in failed_rids:  # died before reaching a slot
+                out.put(StepOutput(request_id=rid, text="", token_id=-1,
+                                   logprob=0.0, finished=True,
+                                   finish_reason=reason))
         for i, slot in enumerate(self._slots):
             if slot is None:
                 continue
@@ -1415,6 +1486,7 @@ class Engine:
                                finish_reason=reason))
 
     def _loop(self):
+        restarts = 0
         while self._running:
             try:
                 busy = self.step()
@@ -1422,10 +1494,25 @@ class Engine:
                 import traceback
 
                 traceback.print_exc()
-                self._running = False
-                self._dead = True
                 self._fail_active("error")
-                return
+                if restarts >= self.ec.max_restarts:
+                    self._running = False
+                    self._dead = True
+                    return
+                restarts += 1
+                # donation may have invalidated the carried device buffers —
+                # rebuild state from scratch (weights are never donated) and
+                # keep serving new requests
+                try:
+                    self._bcast("reset")
+                    self._init_device_state()
+                except Exception:
+                    traceback.print_exc()
+                    self._running = False
+                    self._dead = True
+                    self._fail_active("error")
+                    return
+                continue
             if not busy:
                 self._wake.clear()
                 self._wake.wait(timeout=0.05)

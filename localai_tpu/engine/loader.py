@@ -177,6 +177,7 @@ def load_params(
     *,
     dtype=None,
     mesh=None,
+    specs=None,
 ):
     """Load + restructure a HF Llama-family checkpoint.
 
@@ -204,10 +205,11 @@ def load_params(
         # are deterministic random init on device — lets the serving path be
         # measured at flagship scale without writing tens of GB to disk
         return _synthetic_params(cfg, dtype=dtype, mesh=mesh,
-                                 qbits=qbits)
+                                 qbits=qbits, specs=specs)
 
     r = _TensorReader(model_dir)
-    specs = param_specs(cfg) if mesh is not None else None
+    if mesh is not None and specs is None:
+        specs = param_specs(cfg)
 
     def put(x, spec):
         # host numpy → cast on host → single device_put (sharded when meshed)
@@ -287,7 +289,8 @@ def load_params(
     return params
 
 
-def _synthetic_params(cfg: LlamaConfig, *, dtype, mesh=None, qbits=None):
+def _synthetic_params(cfg: LlamaConfig, *, dtype, mesh=None, qbits=None,
+                      specs=None):
     """Deterministic random params at any scale. The quantized case generates
     the {q, s} leaves DIRECTLY — an 8B bf16 intermediate would not fit
     next to itself on a 16GB chip."""
@@ -297,7 +300,7 @@ def _synthetic_params(cfg: LlamaConfig, *, dtype, mesh=None, qbits=None):
     if qbits is None:
         params = init_params(cfg, jax.random.PRNGKey(0), dtype=dtype)
         if mesh is not None:
-            params = shard_params(params, param_specs(cfg), mesh)
+            params = shard_params(params, specs or param_specs(cfg), mesh)
         return params
 
     h, hd = cfg.hidden_size, cfg.head_dim

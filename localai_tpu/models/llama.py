@@ -170,6 +170,15 @@ def param_specs(cfg: LlamaConfig):
     return specs
 
 
+def replicated_specs(cfg: LlamaConfig):
+    """Fully-replicated PartitionSpecs (same tree as param_specs). The right
+    placement for a draft model whose dims don't divide the TP axis: drafts
+    are small by design, so every chip holds a full copy."""
+    import jax
+
+    return jax.tree_util.tree_map(lambda _: P(), param_specs(cfg))
+
+
 def max_model_axis(cfg: LlamaConfig, n_devices: int) -> int:
     """Largest divisor of n_devices usable as the TP ('model') mesh axis: it
     must divide every dimension param_specs/kv_cache_spec shard on it."""

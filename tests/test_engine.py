@@ -396,3 +396,62 @@ def test_context_shift_generation_crosses_limit(loaded):
     assert shifted[-1].finish_reason == "length"
     assert shifted[-1].generated_tokens == 3 * ctx  # sailed past the cap
     assert all(o.token_id >= 0 for o in shifted)
+
+
+def test_engine_self_restart_after_fatal_step(loaded):
+    """A fatal device error in step() fails the in-flight streams, but the
+    engine rebuilds its device state (weights are never donated) and keeps
+    serving — the in-process analog of the manager reaping + respawning a
+    dead backend, without reloading weights."""
+    cfg, params, tok = loaded
+    eng = Engine(cfg, params, tok, EngineConfig(
+        max_slots=2, max_context=64, prefill_buckets=(16,),
+        prefill_chunk=16, max_restarts=1))
+    fired = {"n": 0}
+    orig_admit = eng._admit_fn
+
+    def boom(*a, **kw):
+        if fired["n"] == 0:
+            fired["n"] += 1
+            raise RuntimeError("injected device fault")
+        return orig_admit(*a, **kw)
+
+    eng._admit_fn = boom
+    eng.start()
+    try:
+        _, q = eng.submit(GenRequest([1, 2, 3], SamplingParams(
+            temperature=0.0), max_tokens=4, ignore_eos=True))
+        o = q.get(timeout=60)
+        while not o.finished:
+            o = q.get(timeout=60)
+        assert o.finish_reason == "error"
+
+        # engine recovered: the next request serves normally
+        _, q2 = eng.submit(GenRequest([1, 2, 3], SamplingParams(
+            temperature=0.0), max_tokens=4, ignore_eos=True))
+        toks = []
+        while True:
+            o = q2.get(timeout=60)
+            toks.append(o.token_id)
+            if o.finished:
+                break
+        assert o.finish_reason == "length" and len(toks) == 4
+
+        # a second fault exceeds max_restarts=1: engine goes dead for good
+        fired["n"] = 0
+        _, q3 = eng.submit(GenRequest([1, 2, 3], SamplingParams(
+            temperature=0.0), max_tokens=4, ignore_eos=True))
+        o = q3.get(timeout=60)
+        while not o.finished:
+            o = q3.get(timeout=60)
+        assert o.finish_reason == "error"
+        import time as _t
+
+        for _ in range(100):          # loop thread flips _dead shortly after
+            if eng._dead:
+                break
+            _t.sleep(0.05)
+        with pytest.raises(RuntimeError, match="terminated"):
+            eng.submit(GenRequest([1, 2, 3], SamplingParams(), max_tokens=2))
+    finally:
+        eng.stop()

@@ -149,3 +149,39 @@ def test_zip_magic_corrupt_file_survives(tmp_path):
         assert len(toks2) == 5
     finally:
         eng.stop()
+
+
+def test_kv_survives_restart_under_mesh(tmp_path):
+    """Single-process mesh (the sharded flagship config): disk prompt-KV
+    save/restore must work — every shard is host-addressable, so the slot
+    slice/inject runs exactly as unmeshed."""
+    from localai_tpu.models.llama import param_specs
+    from localai_tpu.parallel.mesh import MeshConfig, build_mesh, shard_params
+
+    mesh = build_mesh(MeshConfig(data=2, model=2), jax.devices()[:4])
+    params = init_params(CFG, jax.random.PRNGKey(0), jnp.float32)
+
+    def meng():
+        return Engine(CFG, shard_params(params, param_specs(CFG), mesh),
+                      None, EngineConfig(
+                          max_slots=2, max_context=128, prefill_buckets=(64,),
+                          prefill_chunk=64, mesh=mesh))
+
+    path = str(tmp_path / "prompt.kv.npz")
+    prompt = list(range(1, 41))
+    e1 = meng()
+    e1.start()
+    try:
+        ref = _run(e1, prompt, path=path)
+    finally:
+        e1.stop()
+    assert (tmp_path / "prompt.kv.npz").exists()
+
+    e2 = meng()
+    e2.start()
+    try:
+        out = _run(e2, prompt, path=path)
+        assert e2.metrics["prompt_tokens_reused"] == len(prompt) - 1
+        assert out == ref
+    finally:
+        e2.stop()

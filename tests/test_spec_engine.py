@@ -117,3 +117,36 @@ def test_spec_stochastic_runs_and_terminates(models):
                 temperature=0.9, seed=5)
     assert len(toks) == 32
     assert all(0 <= t < 128 for t in toks)
+
+
+@pytest.mark.parametrize("data,model", [(2, 2), (2, 4)])
+def test_spec_under_mesh_matches_unmeshed(models, data, model):
+    """Spec decoding under a TP/DP mesh == no-mesh spec run, token for token.
+
+    model=2 shards the DRAFT too (kv_heads=2 divides); model=4 exercises the
+    replicated-draft fallback (kv_heads=2 does not divide 4)."""
+    from localai_tpu.models.llama import (
+        max_model_axis, param_specs, replicated_specs,
+    )
+    from localai_tpu.parallel.mesh import MeshConfig, build_mesh, shard_params
+
+    params_t, params_d = models
+    prompt = [3, 14, 15, 9, 2, 6]
+    plain = _run(params_t, (DRAFT, params_d), prompt, 24)
+
+    import jax
+
+    mesh = build_mesh(MeshConfig(data=data, model=model),
+                      jax.devices()[: data * model])
+    pt = shard_params(params_t, param_specs(TARGET), mesh)
+    dspecs = (param_specs(DRAFT) if max_model_axis(DRAFT, model) == model
+              else replicated_specs(DRAFT))
+    pd = shard_params(params_d, dspecs, mesh)
+    eng = Engine(TARGET, pt, None, EngineConfig(
+        max_slots=2, max_context=256, prefill_buckets=(32,), gamma=4,
+        mesh=mesh), draft=(DRAFT, pd))
+    out = [o.token_id for o in eng.generate(GenRequest(
+        list(prompt), SamplingParams(temperature=0.0, seed=11),
+        max_tokens=24, ignore_eos=True))]
+    assert out == plain
+    assert eng.metrics["draft_proposed"] > 0   # the spec path actually ran
