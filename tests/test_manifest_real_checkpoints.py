@@ -1,0 +1,120 @@
+"""Manifest-level key-mapping tests at REAL released-checkpoint geometry.
+
+The per-model parity tests use tiny random-weight HF models, which validates
+math but not key-mapping breadth: a renamed key family in the real released
+layout would only surface in production. These tests instantiate the HF
+architectures at the EXACT hyperparameters of real released checkpoints
+(whisper-base, facebook/mms-tts-eng — values from their public config.json),
+save them, and assert our loaders consume every key in the file (minus a
+documented inference-irrelevant skip set) and produce a working forward.
+
+Weights are random (zero-egress image) — the *key manifest and shapes* are
+identical to the released artifacts, which is what these tests pin.
+"""
+import numpy as np
+import pytest
+
+import localai_tpu.engine.loader as loader_mod
+
+
+@pytest.fixture()
+def key_recorder(monkeypatch):
+    """Record every tensor name the loaders request from _TensorReader."""
+    requested: set[str] = set()
+    orig = loader_mod._TensorReader.get
+
+    def tracking_get(self, name):
+        requested.add(name)
+        return orig(self, name)
+
+    monkeypatch.setattr(loader_mod._TensorReader, "get", tracking_get)
+    return requested
+
+
+def _all_keys(model_dir: str) -> set[str]:
+    r = loader_mod._TensorReader(model_dir)
+    try:
+        return set(r.index.keys())
+    finally:
+        r.close()
+
+
+def test_whisper_base_manifest(tmp_path, key_recorder):
+    """openai/whisper-base layout: every checkpoint key is consumed and the
+    enc-dec transcription path runs."""
+    import torch
+    from transformers import WhisperConfig, WhisperForConditionalGeneration
+
+    torch.manual_seed(0)
+    cfg_hf = WhisperConfig(        # public whisper-base config.json values
+        vocab_size=51865, num_mel_bins=80,
+        d_model=512, encoder_layers=6, encoder_attention_heads=8,
+        decoder_layers=6, decoder_attention_heads=8,
+        encoder_ffn_dim=2048, decoder_ffn_dim=2048,
+        max_source_positions=1500, max_target_positions=448)
+    m = WhisperForConditionalGeneration(cfg_hf)
+    m.generation_config.forced_decoder_ids = None
+    m.generation_config.suppress_tokens = None
+    m.generation_config.begin_suppress_tokens = None
+    d = str(tmp_path / "whisper-base")
+    m.save_pretrained(d, safe_serialization=True)
+
+    from localai_tpu.models.whisper import WhisperModel, load_config
+
+    cfg = load_config(d)
+    assert (cfg.d_model, cfg.encoder_layers, cfg.heads) == (512, 6, 8)
+
+    w = WhisperModel(d)
+
+    available = _all_keys(d)
+    unread = available - key_recorder
+    # proj_out is tied to decoder.embed_tokens (dropped by safetensors when
+    # tied; consumed via the embed key when present)
+    unread -= {"proj_out.weight"}
+    assert not unread, f"loader never read: {sorted(unread)[:10]}"
+
+    audio = (0.01 * np.random.default_rng(0).standard_normal(16000)
+             ).astype(np.float32)
+    toks = w.transcribe_tokens(audio, max_tokens=8, beam_size=1,
+                               temperatures=(0.0,))
+    assert isinstance(toks, list)        # random weights → arbitrary ids
+
+
+def test_mms_tts_eng_manifest(tmp_path, key_recorder):
+    """facebook/mms-tts-eng layout (full-size VITS incl. weight-norm
+    parametrizations + stochastic duration predictor): all inference keys
+    consumed, synthesis runs end to end."""
+    import torch
+    from transformers import VitsConfig, VitsModel
+
+    torch.manual_seed(0)
+    # public mms-tts-eng config.json: the architecture fields are the
+    # transformers VitsConfig defaults; eng's vocab is 38
+    cfg_hf = VitsConfig(vocab_size=38)
+    m = VitsModel(cfg_hf)
+    d = str(tmp_path / "mms-tts-eng")
+    m.save_pretrained(d, safe_serialization=True)
+
+    from localai_tpu.models.vits import (
+        load_vits_config, load_vits_params, synthesize_ids,
+    )
+
+    cfg = load_vits_config(d)
+    assert (cfg.hidden_size, cfg.num_layers, cfg.ffn_dim) == (192, 6, 768)
+    assert cfg.upsample_rates == (8, 8, 2, 2)
+    params = load_vits_params(d, cfg)
+
+    available = _all_keys(d)
+    unread = {k for k in available if k not in key_recorder}
+    # the posterior encoder (audio → latent) and the stochastic duration
+    # predictor's post_* branch (posterior over latent durations) exist only
+    # for training; inference runs text encoder + reverse flows + decoder
+    unread = {k for k in unread
+              if not k.startswith("posterior_encoder.")
+              and not k.startswith("duration_predictor.post_")}
+    assert not unread, f"loader never read: {sorted(unread)[:10]}"
+
+    ids = np.array([1, 5, 9, 3, 2, 7], np.int32)
+    wav = synthesize_ids(params, cfg, ids, seed=0)
+    assert wav.ndim == 1 and len(wav) > 256   # 256x upsample of >=1 frame
+    assert np.isfinite(wav).all()
