@@ -51,6 +51,34 @@ class _LatentWrapper:
         return dst
 
 
+class _FluxWrapper:
+    """FluxPipeline → the DiffusionModel file-output surface. Flux is a
+    guidance-distilled rectified-flow model: no negative prompt, few steps."""
+
+    def __init__(self, pipe):
+        self.pipe = pipe
+
+    def generate_image(self, prompt, dst, *, negative_prompt="", width=512,
+                       height=512, steps=4, seed=0):
+        from PIL import Image
+
+        arr = self.pipe.txt2img(prompt, width=width, height=height,
+                                steps=min(steps, 8), seed=seed)
+        Image.fromarray(arr).save(dst)
+        return dst
+
+    def generate_video(self, prompt, dst, *, num_frames=8, fps=4,
+                       width=128, height=128, steps=4, seed=0):
+        from PIL import Image
+
+        frames = [Image.fromarray(self.pipe.txt2img(
+            prompt, width=width, height=height, steps=min(steps, 8),
+            seed=seed + f)) for f in range(num_frames)]
+        frames[0].save(dst, save_all=True, append_images=frames[1:],
+                       duration=int(1000 / fps), loop=0)
+        return dst
+
+
 class ImageServicer(BackendServicer):
     def __init__(self):
         self.model = None
@@ -69,8 +97,15 @@ class ImageServicer(BackendServicer):
                     is_diffusers_checkpoint,
                 )
 
+                from localai_tpu.models.flux import is_flux_checkpoint
+
                 try:
-                    if model_dir and is_diffusers_checkpoint(model_dir):
+                    if model_dir and is_flux_checkpoint(model_dir):
+                        from localai_tpu.models.flux import FluxPipeline
+
+                        self.model = _FluxWrapper(FluxPipeline(
+                            model_dir, dtype=request.dtype or "float32"))
+                    elif model_dir and is_diffusers_checkpoint(model_dir):
                         # real SD-class checkpoint (diffusers layout); a
                         # motion_adapter/ subdir upgrades video to the
                         # temporal AnimateDiff-style pipeline

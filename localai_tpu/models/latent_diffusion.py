@@ -11,10 +11,13 @@ weight dict loaded straight from `unet/`, `vae/`, `text_encoder/`
 safetensors; the DDIM denoise loop is a lax.scan, so one jitted XLA program
 runs the whole trajectory on the MXU (bf16 matmuls/convs, f32 norms).
 
-Supported layout (SD 1.x/2.x geometry, config-driven so tiny test
-checkpoints load the same way): model_index.json at the root plus
-unet/config.json + unet/diffusion_pytorch_model.safetensors, same for vae/,
-text_encoder/ (+ tokenizer/tokenizer.json).
+Supported layouts (config-driven so tiny test checkpoints load the same
+way): model_index.json at the root plus unet/config.json +
+unet/diffusion_pytorch_model.safetensors, same for vae/, text_encoder/
+(+ tokenizer/tokenizer.json). SD 1.x/2.x geometry, and SDXL geometry —
+text_encoder_2 (CLIP-with-projection) conditioning concat, per-block
+transformer depth (`transformer_layers_per_block`), and the `text_time`
+addition embedding (pooled embeds + size/crop micro-conditioning).
 """
 from __future__ import annotations
 
@@ -119,15 +122,26 @@ def timestep_embedding(t, dim: int):
 
 # ------------------------------------------------------------ CLIP text
 
-def clip_encode(w: dict, cfg: dict, tokens):
-    """CLIP text encoder → last hidden state [B, S, H] (pre-LN, causal)."""
+def clip_encode(w: dict, cfg: dict, tokens, *, penultimate=False,
+                with_pooled=False):
+    """CLIP text encoder → last hidden state [B, S, H] (pre-LN, causal).
+
+    `penultimate=True` returns hidden_states[-2] (the input to the final
+    encoder layer, no final LN) — what SDXL conditions on from both of its
+    encoders. `with_pooled=True` additionally returns the pooled embedding:
+    final-LN hidden at the EOT position, through `text_projection` when the
+    checkpoint has one (CLIPTextModelWithProjection, SDXL's second encoder)
+    — then the return is (hidden, pooled)."""
     p = "text_model."
     x = w[p + "embeddings.token_embedding.weight"][tokens]
     x = x + w[p + "embeddings.position_embedding.weight"][: tokens.shape[1]]
     heads = cfg["num_attention_heads"]
     s = tokens.shape[1]
     causal = jnp.tril(jnp.ones((s, s), bool))
+    penult = None
     for i in range(cfg["num_hidden_layers"]):
+        if i == cfg["num_hidden_layers"] - 1:
+            penult = x
         lp = f"{p}encoder.layers.{i}."
         h = layer_norm(x, w[lp + "layer_norm1.weight"],
                        w[lp + "layer_norm1.bias"])
@@ -154,8 +168,23 @@ def clip_encode(w: dict, cfg: dict, tokens):
         h = linear(h, w[lp + "mlp.fc1.weight"], w[lp + "mlp.fc1.bias"])
         h = h * jax.nn.sigmoid(1.702 * h)          # quick_gelu
         x = x + linear(h, w[lp + "mlp.fc2.weight"], w[lp + "mlp.fc2.bias"])
-    return layer_norm(x, w[p + "final_layer_norm.weight"],
-                      w[p + "final_layer_norm.bias"])
+    final = layer_norm(x, w[p + "final_layer_norm.weight"],
+                       w[p + "final_layer_norm.bias"])
+    hidden = penult if penultimate else final
+    if not with_pooled:
+        return hidden
+    # HF CLIP pooler: first EOS position; legacy configs (eos_token_id=2)
+    # keep the original argmax-of-ids behavior (EOT is the largest CLIP id
+    # and SD pipelines pad with it)
+    eos_id = cfg.get("eos_token_id", 49407)
+    if eos_id == 2:
+        eot = jnp.argmax(tokens, axis=-1)
+    else:
+        eot = jnp.argmax((tokens == eos_id).astype(jnp.int32), axis=-1)
+    pooled = final[jnp.arange(tokens.shape[0]), eot]
+    if "text_projection.weight" in w:
+        pooled = linear(pooled, w["text_projection.weight"])
+    return hidden, pooled
 
 
 # ------------------------------------------------------------ UNet blocks
@@ -223,14 +252,23 @@ def _spatial_transformer(w, pfx, x, ctx, heads, groups, depth=1):
     return x + res
 
 
-def unet_apply(w: dict, cfg: dict, latents, t, ctx):
-    """UNet2DCondition forward: latents [B,H,W,4], t [B], ctx [B,S,D]."""
+def unet_apply(w: dict, cfg: dict, latents, t, ctx,
+               add_text_embeds=None, add_time_ids=None):
+    """UNet2DCondition forward: latents [B,H,W,4], t [B], ctx [B,S,D].
+
+    SDXL geometry (gosd.cpp / diffusers SDXL role): per-block transformer
+    depth via `transformer_layers_per_block`, and the `text_time` addition
+    embedding — pooled text embeds [B, P] + Fourier-embedded micro-cond
+    time_ids [B, 6] through add_embedding, summed into the time embedding."""
     groups = cfg.get("norm_num_groups", 32)
     chans = cfg["block_out_channels"]
     lpb = cfg.get("layers_per_block", 2)
     head_dim = cfg.get("attention_head_dim", 8)
     head_dims = (head_dim if isinstance(head_dim, list)
                  else [head_dim] * len(chans))
+    tlpb = cfg.get("transformer_layers_per_block", 1)
+    depths = (list(tlpb) if isinstance(tlpb, (list, tuple))
+              else [tlpb] * len(chans))
     down_types = cfg["down_block_types"]
     up_types = cfg["up_block_types"]
 
@@ -239,6 +277,19 @@ def unet_apply(w: dict, cfg: dict, latents, t, ctx):
                   w["time_embedding.linear_1.bias"])
     temb = linear(jax.nn.silu(temb), w["time_embedding.linear_2.weight"],
                   w["time_embedding.linear_2.bias"])
+
+    if cfg.get("addition_embed_type") == "text_time":
+        atd = cfg.get("addition_time_embed_dim", 256)
+        b = add_time_ids.shape[0]
+        tid = timestep_embedding(add_time_ids.reshape(-1), atd)
+        aug = jnp.concatenate(
+            [add_text_embeds, tid.reshape(b, -1).astype(add_text_embeds.dtype)],
+            axis=-1)
+        aug = linear(aug, w["add_embedding.linear_1.weight"],
+                     w["add_embedding.linear_1.bias"])
+        aug = linear(jax.nn.silu(aug), w["add_embedding.linear_2.weight"],
+                     w["add_embedding.linear_2.bias"])
+        temb = temb + aug
 
     x = conv2d(latents, w["conv_in.weight"], w["conv_in.bias"])
     skips = [x]
@@ -249,7 +300,7 @@ def unet_apply(w: dict, cfg: dict, latents, t, ctx):
             if "CrossAttn" in btype:
                 x = _spatial_transformer(
                     w, f"down_blocks.{i}.attentions.{j}.", x, ctx, heads,
-                    groups)
+                    groups, depth=depths[i])
             skips.append(x)
         if f"down_blocks.{i}.downsamplers.0.conv.weight" in w:
             x = conv2d(x, w[f"down_blocks.{i}.downsamplers.0.conv.weight"],
@@ -260,7 +311,7 @@ def unet_apply(w: dict, cfg: dict, latents, t, ctx):
     heads_mid = max(1, chans[-1] // head_dims[-1])
     x = _resnet(w, "mid_block.resnets.0.", x, temb, groups)
     x = _spatial_transformer(w, "mid_block.attentions.0.", x, ctx,
-                             heads_mid, groups)
+                             heads_mid, groups, depth=depths[-1])
     x = _resnet(w, "mid_block.resnets.1.", x, temb, groups)
 
     for i, btype in enumerate(up_types):
@@ -272,7 +323,7 @@ def unet_apply(w: dict, cfg: dict, latents, t, ctx):
             if "CrossAttn" in btype:
                 x = _spatial_transformer(
                     w, f"up_blocks.{i}.attentions.{j}.", x, ctx, heads,
-                    groups)
+                    groups, depth=depths[ch_i])
         if f"up_blocks.{i}.upsamplers.0.conv.weight" in w:
             n, h_, w_, c = x.shape
             x = jax.image.resize(x, (n, h_ * 2, w_ * 2, c), "nearest")
@@ -357,12 +408,26 @@ class LatentDiffusion:
         self.vae_w = to_jax(_component_weights(self.model_dir, "vae"))
         self.text_w = to_jax(_component_weights(self.model_dir,
                                                 "text_encoder"))
-        self.tokenizer = None
-        tok_path = os.path.join(self.model_dir, "tokenizer", "tokenizer.json")
-        if os.path.exists(tok_path):
-            from tokenizers import Tokenizer as HFTok
+        # SDXL: a second (projection) text encoder conditions the UNet
+        # jointly with the first and supplies the pooled `text_embeds`
+        self.is_xl = os.path.isdir(
+            os.path.join(self.model_dir, "text_encoder_2"))
+        if self.is_xl:
+            self.text2_cfg = _component_config(self.model_dir,
+                                               "text_encoder_2")
+            self.text2_w = to_jax(_component_weights(self.model_dir,
+                                                     "text_encoder_2"))
 
-            self.tokenizer = HFTok.from_file(tok_path)
+        def load_tok(sub):
+            p = os.path.join(self.model_dir, sub, "tokenizer.json")
+            if os.path.exists(p):
+                from tokenizers import Tokenizer as HFTok
+
+                return HFTok.from_file(p)
+            return None
+
+        self.tokenizer = load_tok("tokenizer")
+        self.tokenizer_2 = load_tok("tokenizer_2") or self.tokenizer
 
         # latent downscale = one halving per VAE block transition (8 for SD)
         self.vae_scale = 2 ** (len(self.vae_cfg["block_out_channels"]) - 1)
@@ -374,11 +439,13 @@ class LatentDiffusion:
         self._sample = jax.jit(
             partial(self._sample_impl), static_argnames=("steps", "h", "w"))
 
-    def _encode_text(self, prompt: str):
-        s = min(self.text_cfg.get("max_position_embeddings", 77), 77)
-        if self.tokenizer is not None:
-            eos = self.tokenizer.token_to_id("<|endoftext|>")
-            ids = self.tokenizer.encode(prompt).ids
+    def _encode_text(self, prompt: str, tokenizer=None, cfg=None):
+        tokenizer = tokenizer if tokenizer is not None else self.tokenizer
+        cfg = cfg or self.text_cfg
+        s = min(cfg.get("max_position_embeddings", 77), 77)
+        if tokenizer is not None:
+            eos = tokenizer.token_to_id("<|endoftext|>")
+            ids = tokenizer.encode(prompt).ids
             if eos is not None:
                 # diffusers pads to 77 with EOS and never truncates it away
                 ids = ids[: s - 1] + [eos]
@@ -388,7 +455,7 @@ class LatentDiffusion:
         else:   # stable-hash fallback for tokenizer-less tiny checkpoints
             import zlib
 
-            v = self.text_cfg["vocab_size"]
+            v = cfg["vocab_size"]
             ids = [zlib.crc32(tk.encode()) % v
                    for tk in prompt.lower().split()][:s]
             ids = ids + [0] * (s - len(ids))
@@ -396,7 +463,15 @@ class LatentDiffusion:
 
     def _sample_impl(self, cond, uncond, key, *, steps, h, w,
                      guidance_scale):
-        ctx = jnp.concatenate([uncond, cond], axis=0)
+        pooled = time_ids = None
+        if isinstance(cond, tuple):   # SDXL: (ctx, pooled) per side
+            ctx = jnp.concatenate([uncond[0], cond[0]], axis=0)
+            pooled = jnp.concatenate([uncond[1], cond[1]], axis=0)
+            # micro-conditioning: original size, crop origin, target size
+            time_ids = jnp.tile(
+                jnp.asarray([[h, w, 0, 0, h, w]], jnp.float32), (2, 1))
+        else:
+            ctx = jnp.concatenate([uncond, cond], axis=0)
         lc = self.vae_cfg.get("latent_channels", 4)
         latents = jax.random.normal(
             key, (1, h // self.vae_scale, w // self.vae_scale, lc),
@@ -409,7 +484,8 @@ class LatentDiffusion:
                                                              steps - 1)], -1)
             lat2 = jnp.concatenate([lat, lat], axis=0).astype(ctx.dtype)
             eps = unet_apply(self.unet_w, self.unet_cfg, lat2,
-                             jnp.full((2,), t, jnp.int32), ctx)
+                             jnp.full((2,), t, jnp.int32), ctx,
+                             add_text_embeds=pooled, add_time_ids=time_ids)
             eps = eps.astype(jnp.float32)
             eps_u, eps_c = eps[:1], eps[1:]
             e = eps_u + guidance_scale * (eps_c - eps_u)
@@ -424,11 +500,28 @@ class LatentDiffusion:
                           latents.astype(ctx.dtype))
 
     def encode_prompts(self, prompt: str, negative_prompt: str = ""):
-        """(cond, uncond) CLIP embeddings — reusable across frames/seeds."""
-        return (clip_encode(self.text_w, self.text_cfg,
-                            self._encode_text(prompt)),
-                clip_encode(self.text_w, self.text_cfg,
-                            self._encode_text(negative_prompt)))
+        """(cond, uncond) CLIP embeddings — reusable across frames/seeds.
+
+        SD 1.x/2.x: each side is the final-LN hidden state [1, 77, D].
+        SDXL: each side is (ctx, pooled) — ctx the channel-concat of both
+        encoders' penultimate hidden states [1, 77, D1+D2], pooled the
+        projected EOT embedding of encoder 2 [1, P]."""
+        if not self.is_xl:
+            return (clip_encode(self.text_w, self.text_cfg,
+                                self._encode_text(prompt)),
+                    clip_encode(self.text_w, self.text_cfg,
+                                self._encode_text(negative_prompt)))
+
+        def enc(text):
+            h1 = clip_encode(self.text_w, self.text_cfg,
+                             self._encode_text(text), penultimate=True)
+            h2, pooled = clip_encode(
+                self.text2_w, self.text2_cfg,
+                self._encode_text(text, self.tokenizer_2, self.text2_cfg),
+                penultimate=True, with_pooled=True)
+            return jnp.concatenate([h1, h2], axis=-1), pooled
+
+        return enc(prompt), enc(negative_prompt)
 
     def sample(self, cond, uncond, *, width: int, height: int,
                steps: int = 20, guidance_scale: float = 7.5,

@@ -243,3 +243,298 @@ def build_tiny_sd_checkpoint(dirpath: str) -> str:
         json.dump({"block_out_channels": [V0, V1], "latent_channels": 4,
                    "norm_num_groups": G, "scaling_factor": 0.18215}, f)
     return dirpath
+
+
+def build_tiny_sdxl_checkpoint(dirpath: str) -> str:
+    """Tiny SDXL-geometry checkpoint: dual text encoders (the second with a
+    projection head), transformer_layers_per_block, and the text_time
+    addition embedding — the structural deltas SDXL adds over SD 1.x/2.x."""
+    import numpy as np
+    import torch
+    from transformers import (
+        CLIPTextConfig, CLIPTextModel, CLIPTextModelWithProjection,
+    )
+
+    rng = np.random.default_rng(1)
+
+    def t(*shape, scale=None):
+        scale = scale if scale is not None else (shape[-1] ** -0.5 if
+                                                 len(shape) > 1 else 0.02)
+        return (rng.standard_normal(shape) * scale).astype(np.float32)
+
+    os.makedirs(dirpath, exist_ok=True)
+    with open(os.path.join(dirpath, "model_index.json"), "w") as f:
+        json.dump({"_class_name": "StableDiffusionXLPipeline"}, f)
+
+    # ---- text encoders: CLIP-L role (hidden H1) + OpenCLIP-G role
+    # (hidden H2, projection head → pooled text_embeds)
+    H1, H2, PROJ = 32, 48, 48
+    torch.manual_seed(0)
+    CLIPTextModel(CLIPTextConfig(
+        vocab_size=256, hidden_size=H1, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=77)).save_pretrained(
+        os.path.join(dirpath, "text_encoder"), safe_serialization=True)
+    CLIPTextModelWithProjection(CLIPTextConfig(
+        vocab_size=256, hidden_size=H2, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, projection_dim=PROJ,
+        bos_token_id=254, eos_token_id=255,   # reachable in the tiny vocab
+        max_position_embeddings=77)).save_pretrained(
+        os.path.join(dirpath, "text_encoder_2"), safe_serialization=True)
+
+    # ---- unet: SDXL structure — first down block attention-free,
+    # transformer depth 2 on the deep block, text_time add embedding
+    C0, C1, TE, CROSS, G, ATD = 32, 64, 64, H1 + H2, 8, 8
+    u = {}
+
+    def conv(name, o, i, k=3):
+        u[name + ".weight"] = t(o, i, k, k)
+        u[name + ".bias"] = np.zeros((o,), np.float32)
+
+    def norm(name, c):
+        u[name + ".weight"] = np.ones((c,), np.float32)
+        u[name + ".bias"] = np.zeros((c,), np.float32)
+
+    def lin(name, o, i, bias=True):
+        u[name + ".weight"] = t(o, i)
+        if bias:
+            u[name + ".bias"] = np.zeros((o,), np.float32)
+
+    def resnet(p, cin, cout, temb=True):
+        norm(p + "norm1", cin)
+        conv(p + "conv1", cout, cin)
+        if temb:
+            lin(p + "time_emb_proj", cout, TE)
+        norm(p + "norm2", cout)
+        conv(p + "conv2", cout, cout)
+        if cin != cout:
+            conv(p + "conv_shortcut", cout, cin, k=1)
+
+    def xattn(p, c, depth=1):
+        norm(p + "norm", c)
+        lin(p + "proj_in", c, c)        # use_linear_projection (SDXL)
+        for d in range(depth):
+            b = f"{p}transformer_blocks.{d}."
+            norm(b + "norm1", c)
+            lin(b + "attn1.to_q", c, c, bias=False)
+            lin(b + "attn1.to_k", c, c, bias=False)
+            lin(b + "attn1.to_v", c, c, bias=False)
+            lin(b + "attn1.to_out.0", c, c)
+            norm(b + "norm2", c)
+            lin(b + "attn2.to_q", c, c, bias=False)
+            lin(b + "attn2.to_k", c, CROSS, bias=False)
+            lin(b + "attn2.to_v", c, CROSS, bias=False)
+            lin(b + "attn2.to_out.0", c, c)
+            norm(b + "norm3", c)
+            lin(b + "ff.net.0.proj", 8 * c, c)
+            lin(b + "ff.net.2", c, 4 * c)
+        lin(p + "proj_out", c, c)
+
+    conv("conv_in", C0, 4)
+    lin("time_embedding.linear_1", TE, C0)
+    lin("time_embedding.linear_2", TE, TE)
+    # text_time addition embedding: in = pooled PROJ + 6 * ATD fourier dims
+    lin("add_embedding.linear_1", TE, PROJ + 6 * ATD)
+    lin("add_embedding.linear_2", TE, TE)
+    # down 0: plain (SDXL's first block has no attention); down 1: depth-2
+    resnet("down_blocks.0.resnets.0.", C0, C0)
+    conv("down_blocks.0.downsamplers.0.conv", C0, C0)
+    resnet("down_blocks.1.resnets.0.", C0, C1)
+    xattn("down_blocks.1.attentions.0.", C1, depth=2)
+    resnet("mid_block.resnets.0.", C1, C1)
+    xattn("mid_block.attentions.0.", C1, depth=2)
+    resnet("mid_block.resnets.1.", C1, C1)
+    # up 0 mirrors down 1 (crossattn, depth 2); up 1 plain
+    resnet("up_blocks.0.resnets.0.", C1 + C1, C1)
+    xattn("up_blocks.0.attentions.0.", C1, depth=2)
+    resnet("up_blocks.0.resnets.1.", C1 + C0, C1)
+    xattn("up_blocks.0.attentions.1.", C1, depth=2)
+    conv("up_blocks.0.upsamplers.0.conv", C1, C1)
+    resnet("up_blocks.1.resnets.0.", C1 + C0, C0)
+    resnet("up_blocks.1.resnets.1.", C0 + C0, C0)
+    norm("conv_norm_out", C0)
+    conv("conv_out", 4, C0)
+
+    ud = os.path.join(dirpath, "unet")
+    os.makedirs(ud, exist_ok=True)
+    _write_safetensors(os.path.join(ud, "diffusion_pytorch_model.safetensors"), u)
+    with open(os.path.join(ud, "config.json"), "w") as f:
+        json.dump({
+            "block_out_channels": [C0, C1], "layers_per_block": 1,
+            "attention_head_dim": [4, 8], "cross_attention_dim": CROSS,
+            "transformer_layers_per_block": [1, 2],
+            "addition_embed_type": "text_time",
+            "addition_time_embed_dim": ATD,
+            "norm_num_groups": G, "in_channels": 4, "out_channels": 4,
+            "down_block_types": ["DownBlock2D", "CrossAttnDownBlock2D"],
+            "up_block_types": ["CrossAttnUpBlock2D", "UpBlock2D"],
+        }, f)
+
+    # ---- vae decoder (SDXL scaling factor)
+    u = {}
+    V0, V1 = 32, 64
+    conv("post_quant_conv", 4, 4, k=1)
+    conv("decoder.conv_in", V1, 4)
+    resnet("decoder.mid_block.resnets.0.", V1, V1, temb=False)
+    norm("decoder.mid_block.attentions.0.group_norm", V1)
+    lin("decoder.mid_block.attentions.0.to_q", V1, V1)
+    lin("decoder.mid_block.attentions.0.to_k", V1, V1)
+    lin("decoder.mid_block.attentions.0.to_v", V1, V1)
+    lin("decoder.mid_block.attentions.0.to_out.0", V1, V1)
+    resnet("decoder.mid_block.resnets.1.", V1, V1, temb=False)
+    for j in range(3):
+        resnet(f"decoder.up_blocks.0.resnets.{j}.", V1, V1, temb=False)
+    conv("decoder.up_blocks.0.upsamplers.0.conv", V1, V1)
+    resnet("decoder.up_blocks.1.resnets.0.", V1, V0, temb=False)
+    for j in (1, 2):
+        resnet(f"decoder.up_blocks.1.resnets.{j}.", V0, V0, temb=False)
+    norm("decoder.conv_norm_out", V0)
+    conv("decoder.conv_out", 3, V0)
+
+    vd = os.path.join(dirpath, "vae")
+    os.makedirs(vd, exist_ok=True)
+    _write_safetensors(os.path.join(vd, "diffusion_pytorch_model.safetensors"), u)
+    with open(os.path.join(vd, "config.json"), "w") as f:
+        json.dump({"block_out_channels": [V0, V1], "latent_channels": 4,
+                   "norm_num_groups": G, "scaling_factor": 0.13025}, f)
+    return dirpath
+
+
+def build_tiny_flux_checkpoint(dirpath: str) -> str:
+    """Tiny Flux-geometry checkpoint (diffusers FluxPipeline layout): CLIP
+    pooled vector + T5 sequence conditioning, double- and single-stream
+    MMDiT blocks with 3-axis rope + QK RMS norms, 2x2-packed latents."""
+    import numpy as np
+    import torch
+    from transformers import (
+        CLIPTextConfig, CLIPTextModel, T5Config, T5EncoderModel,
+    )
+
+    rng = np.random.default_rng(2)
+    os.makedirs(dirpath, exist_ok=True)
+    with open(os.path.join(dirpath, "model_index.json"), "w") as f:
+        json.dump({"_class_name": "FluxPipeline"}, f)
+
+    HID, HEADS, HD = 32, 4, 8            # transformer hidden / heads
+    T5D, CLIPH, LC = 16, 24, 4           # t5 d_model, clip hidden, latents
+
+    torch.manual_seed(0)
+    CLIPTextModel(CLIPTextConfig(
+        vocab_size=256, hidden_size=CLIPH, intermediate_size=48,
+        num_hidden_layers=2, num_attention_heads=4,
+        bos_token_id=254, eos_token_id=255,
+        max_position_embeddings=77)).save_pretrained(
+        os.path.join(dirpath, "text_encoder"), safe_serialization=True)
+    T5EncoderModel(T5Config(
+        vocab_size=128, d_model=T5D, d_kv=8, d_ff=32, num_layers=2,
+        num_heads=2, feed_forward_proj="gated-gelu",
+        relative_attention_num_buckets=8,
+        relative_attention_max_distance=16)).save_pretrained(
+        os.path.join(dirpath, "text_encoder_2"), safe_serialization=True)
+
+    u = {}
+
+    def t(*shape, scale=None):
+        scale = scale if scale is not None else (shape[-1] ** -0.5 if
+                                                 len(shape) > 1 else 0.02)
+        return (rng.standard_normal(shape) * scale).astype(np.float32)
+
+    def lin(name, o, i, bias=True):
+        u[name + ".weight"] = t(o, i)
+        if bias:
+            u[name + ".bias"] = np.zeros((o,), np.float32)
+
+    def ones(name, n):
+        u[name + ".weight"] = np.ones((n,), np.float32)
+
+    lin("x_embedder", HID, LC * 4)
+    lin("context_embedder", HID, T5D)
+    lin("time_text_embed.timestep_embedder.linear_1", HID, 256)
+    lin("time_text_embed.timestep_embedder.linear_2", HID, HID)
+    lin("time_text_embed.guidance_embedder.linear_1", HID, 256)
+    lin("time_text_embed.guidance_embedder.linear_2", HID, HID)
+    lin("time_text_embed.text_embedder.linear_1", HID, CLIPH)
+    lin("time_text_embed.text_embedder.linear_2", HID, HID)
+    b = "transformer_blocks.0."
+    lin(b + "norm1.linear", 6 * HID, HID)
+    lin(b + "norm1_context.linear", 6 * HID, HID)
+    for n in ("to_q", "to_k", "to_v", "add_q_proj", "add_k_proj",
+              "add_v_proj"):
+        lin(b + "attn." + n, HID, HID)
+    for n in ("norm_q", "norm_k", "norm_added_q", "norm_added_k"):
+        ones(b + "attn." + n, HD)
+    lin(b + "attn.to_out.0", HID, HID)
+    lin(b + "attn.to_add_out", HID, HID)
+    lin(b + "ff.net.0.proj", 4 * HID, HID)
+    lin(b + "ff.net.2", HID, 4 * HID)
+    lin(b + "ff_context.net.0.proj", 4 * HID, HID)
+    lin(b + "ff_context.net.2", HID, 4 * HID)
+    s = "single_transformer_blocks.0."
+    lin(s + "norm.linear", 3 * HID, HID)
+    for n in ("to_q", "to_k", "to_v"):
+        lin(s + "attn." + n, HID, HID)
+    ones(s + "attn.norm_q", HD)
+    ones(s + "attn.norm_k", HD)
+    lin(s + "proj_mlp", 4 * HID, HID)
+    lin(s + "proj_out", HID, 5 * HID)
+    lin("norm_out.linear", 2 * HID, HID)
+    lin("proj_out", LC * 4, HID)
+
+    td = os.path.join(dirpath, "transformer")
+    os.makedirs(td, exist_ok=True)
+    _write_safetensors(os.path.join(td, "diffusion_pytorch_model.safetensors"), u)
+    with open(os.path.join(td, "config.json"), "w") as f:
+        json.dump({
+            "num_layers": 1, "num_single_layers": 1,
+            "num_attention_heads": HEADS, "attention_head_dim": HD,
+            "in_channels": LC * 4, "joint_attention_dim": T5D,
+            "pooled_projection_dim": CLIPH, "guidance_embeds": True,
+            "axes_dims_rope": [2, 4, 2],
+        }, f)
+
+    # vae decoder (16ch-flux role at tiny scale; latent_channels=LC)
+    u = {}
+    V0, V1, G = 32, 64, 8
+
+    def conv(name, o, i, k=3):
+        u[name + ".weight"] = t(o, i, k, k)
+        u[name + ".bias"] = np.zeros((o,), np.float32)
+
+    def norm(name, c):
+        u[name + ".weight"] = np.ones((c,), np.float32)
+        u[name + ".bias"] = np.zeros((c,), np.float32)
+
+    def resnet(p, cin, cout):
+        norm(p + "norm1", cin)
+        conv(p + "conv1", cout, cin)
+        norm(p + "norm2", cout)
+        conv(p + "conv2", cout, cout)
+        if cin != cout:
+            conv(p + "conv_shortcut", cout, cin, k=1)
+
+    conv("post_quant_conv", LC, LC, k=1)
+    conv("decoder.conv_in", V1, LC)
+    resnet("decoder.mid_block.resnets.0.", V1, V1)
+    norm("decoder.mid_block.attentions.0.group_norm", V1)
+    lin("decoder.mid_block.attentions.0.to_q", V1, V1)
+    lin("decoder.mid_block.attentions.0.to_k", V1, V1)
+    lin("decoder.mid_block.attentions.0.to_v", V1, V1)
+    lin("decoder.mid_block.attentions.0.to_out.0", V1, V1)
+    resnet("decoder.mid_block.resnets.1.", V1, V1)
+    for j in range(3):
+        resnet(f"decoder.up_blocks.0.resnets.{j}.", V1, V1)
+    conv("decoder.up_blocks.0.upsamplers.0.conv", V1, V1)
+    resnet("decoder.up_blocks.1.resnets.0.", V1, V0)
+    for j in (1, 2):
+        resnet(f"decoder.up_blocks.1.resnets.{j}.", V0, V0)
+    norm("decoder.conv_norm_out", V0)
+    conv("decoder.conv_out", 3, V0)
+
+    vd = os.path.join(dirpath, "vae")
+    os.makedirs(vd, exist_ok=True)
+    _write_safetensors(os.path.join(vd, "diffusion_pytorch_model.safetensors"), u)
+    with open(os.path.join(vd, "config.json"), "w") as f:
+        json.dump({"block_out_channels": [V0, V1], "latent_channels": LC,
+                   "norm_num_groups": G, "scaling_factor": 0.3611,
+                   "shift_factor": 0.1159}, f)
+    return dirpath
