@@ -29,14 +29,16 @@ _state = threading.local()
 class MeshConfig:
     """Mesh shape knobs (YAML `tensor_parallel` etc. map here).
 
-    data × model × seq must equal the device count; axes of size 1 are fine.
-    seq > 1 adds a third 'seq' axis for ring-attention sequence parallelism
+    data × model × seq × pipe must equal the device count; axes of size 1 are
+    fine. seq > 1 adds a 'seq' axis for ring-attention sequence parallelism
     (parallel/ring_attention.py) — long-prompt prefill shards the sequence
-    over it.
+    over it. pipe > 1 adds a 'pipe' axis for GPipe-style pipeline
+    parallelism (parallel/pipeline.py) — stacked layer params shard over it.
     """
     data: int = 1
     model: int = 1
     seq: int = 1
+    pipe: int = 1
 
     def axis_sizes(self) -> tuple[int, int]:
         return self.data, self.model
@@ -53,13 +55,18 @@ def build_mesh(cfg: MeshConfig | None = None, devices=None) -> Mesh:
         cfg = MeshConfig(data=1, model=n)
     d, m = cfg.axis_sizes()
     s = getattr(cfg, "seq", 1) or 1
-    if d * m * s != n:
+    p = getattr(cfg, "pipe", 1) or 1
+    if d * m * s * p != n:
         raise ValueError(f"mesh {d}x{m}" + (f"x{s}" if s > 1 else "")
-                         + f" != {n} devices")
+                         + (f"x{p}" if p > 1 else "") + f" != {n} devices")
+    sizes, names = [d, m], ["data", "model"]
     if s > 1:
-        return Mesh(np.array(devices).reshape(d, m, s),
-                    ("data", "model", "seq"))
-    return Mesh(np.array(devices).reshape(d, m), ("data", "model"))
+        sizes.append(s)
+        names.append("seq")
+    if p > 1:
+        sizes.append(p)
+        names.append("pipe")
+    return Mesh(np.array(devices).reshape(*sizes), tuple(names))
 
 
 def seq_axis_size(mesh: Mesh | None) -> int:

@@ -127,6 +127,57 @@ def test_concurrent_streams_with_different_sampling(loaded):
     assert results[outs[0][0]][0] == ref
 
 
+def test_burst_admission_matches_sequential(loaded):
+    """A burst of simultaneous submissions rides the batched-admission path
+    (_flush_admits: one prefill device call per (bucket, heavy) group, padded
+    by repetition) and must emit token streams identical to admitting each
+    request alone — per-request RNG is keyed on the request, not the path."""
+    cfg, params, tok = loaded
+    prompts = ["pack my box", "sphinx of black", "hello", "the quick brown",
+               "jump over"]
+    # mixed groups: 3 light seeded + 1 greedy light + 1 heavy (penalty)
+    reqs = [
+        GenRequest(tok.encode(p), SamplingParams(temperature=0.8, top_k=20,
+                                                 seed=11 + i),
+                   max_tokens=6, ignore_eos=True)
+        for i, p in enumerate(prompts[:3])
+    ] + [
+        GenRequest(tok.encode(prompts[3]), SamplingParams(temperature=0.0),
+                   max_tokens=6, ignore_eos=True),
+        GenRequest(tok.encode(prompts[4]),
+                   SamplingParams(temperature=0.0, repeat_penalty=3.0),
+                   max_tokens=6, ignore_eos=True),
+    ]
+
+    def run_burst():
+        eng = Engine(cfg, params, tok,
+                     EngineConfig(max_slots=8, max_context=128,
+                                  prefill_buckets=(32,)))
+        outs = [eng.submit(r) for r in reqs]
+        for _ in range(300):
+            if not eng.step():
+                break
+        toks = {}
+        for rid, q in outs:
+            seq = []
+            while not q.empty():
+                seq.append(q.get().token_id)
+            toks[rid] = seq
+        return [toks[rid] for rid, _ in outs]
+
+    def run_sequential():
+        res = []
+        for r in reqs:
+            eng = Engine(cfg, params, tok,
+                         EngineConfig(max_slots=1, max_context=128,
+                                      prefill_buckets=(32,)))
+            res.append([o.token_id for o in eng.generate(r)])
+        return res
+
+    burst, seq = run_burst(), run_sequential()
+    assert burst == seq
+
+
 def test_stop_sequence_truncates(loaded):
     cfg, params, tok = loaded
     eng = Engine(cfg, params, tok, EngineConfig(max_slots=1, max_context=128,
@@ -408,7 +459,7 @@ def test_engine_self_restart_after_fatal_step(loaded):
         max_slots=2, max_context=64, prefill_buckets=(16,),
         prefill_chunk=16, max_restarts=1))
     fired = {"n": 0}
-    orig_admit = eng._admit_fn
+    orig_admit = eng._admit_many_fn
 
     def boom(*a, **kw):
         if fired["n"] == 0:
@@ -416,7 +467,7 @@ def test_engine_self_restart_after_fatal_step(loaded):
             raise RuntimeError("injected device fault")
         return orig_admit(*a, **kw)
 
-    eng._admit_fn = boom
+    eng._admit_many_fn = boom
     eng.start()
     try:
         _, q = eng.submit(GenRequest([1, 2, 3], SamplingParams(
