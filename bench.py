@@ -262,6 +262,15 @@ def bench_serve(args, size: str, on_cpu: bool):
                 tput.append(len(all_arr) / wall)
             note(f"window {w}: {tput[-1]:.1f} tok/s "
                  f"({len(all_arr)} tokens, wall {wall:.1f}s)")
+        try:
+            m = handle.client.metrics()
+            d, s = m.get("decode_dispatches", 0), m.get(
+                "decode_steps_dispatched", 0)
+            note(f"engine: {d:.0f} decode dispatches, {s:.0f} steps "
+                 f"({s / max(d, 1):.1f} steps/dispatch), "
+                 f"{m.get('admit_dispatches', 0):.0f} admit dispatches")
+        except Exception:
+            pass
         return statistics.median(tput), ttft_ms, context, dtype
     finally:
         manager.stop_all()
@@ -297,6 +306,10 @@ def bench_engine(args, size: str, on_cpu: bool):
         max_slots=args.slots, max_context=context,
         prefill_buckets=(128, min(512, context)),
         prefill_chunk=min(512, context),
+        # mirror bench_serve's KV config (was silently dense-bf16 before:
+        # 32-slot engine-mode runs OOM'd at admit compile)
+        cache_type="int8" if dtype in ("int8", "int4") else "",
+        kv_pages=args.kv_pages,
     ))
     rng = np.random.default_rng(0)
 
@@ -310,6 +323,11 @@ def bench_engine(args, size: str, on_cpu: bool):
     t0 = time.perf_counter()
     for _ in range(args.slots):
         eng.submit(req(4))
+    while eng.step():
+        pass
+    # a lone request admits through the K=1 program — compile it now or the
+    # first TTFT probe pays the compile (serve-mode warmup already does this)
+    eng.submit(req(4))
     while eng.step():
         pass
     note(f"warmup (compile) done in {time.perf_counter() - t0:.1f}s")
@@ -341,6 +359,12 @@ def bench_engine(args, size: str, on_cpu: bool):
         tput.append((eng.metrics["tokens_generated"] - n0) / dt)
         while eng.step():
             pass
+    m = eng.metrics
+    d = max(m["decode_dispatches"], 1)
+    note(f"engine: {m['decode_dispatches']} decode dispatches, "
+         f"{m['decode_steps_dispatched']} steps "
+         f"({m['decode_steps_dispatched'] / d:.1f} steps/dispatch), "
+         f"{m['admit_dispatches']} admit dispatches")
     import shutil
 
     shutil.rmtree(tmp, ignore_errors=True)

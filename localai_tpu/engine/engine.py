@@ -267,6 +267,12 @@ class Engine:
             "prompt_cache_hits": 0,
             "ttft_ms_last": 0.0,
             "tokens_per_second_last": 0.0,
+            # dispatch-fusing telemetry: on a tunneled chip each dispatch
+            # pays the link RTT, so decode_steps_dispatched /
+            # decode_dispatches is the number that explains serve throughput
+            "decode_dispatches": 0,
+            "decode_steps_dispatched": 0,
+            "admit_dispatches": 0,
         }
         if self._draft is not None:
             self.metrics["draft_proposed"] = 0
@@ -550,6 +556,7 @@ class Engine:
             None if counts_row is None else np.asarray(counts_row)[None])
 
     def _dev_admit_many(self, ids, lens, slots, rows, counts_rows):
+        self.metrics["admit_dispatches"] += 1
         self._bcast("admit_many", ids=ids, lens=lens, slots=slots,
                     rows={k: np.asarray(v) for k, v in rows.items()},
                     counts_rows=counts_rows)
@@ -587,6 +594,8 @@ class Engine:
                 self._tab())
 
     def _dev_decode(self, active, mask_host=None, fast_width=None):
+        self.metrics["decode_dispatches"] += 1
+        self.metrics["decode_steps_dispatched"] += 1
         self._bcast("decode", active=active,
                     mask=None if mask_host is None else mask_host,
                     fast_width=fast_width)
@@ -610,6 +619,8 @@ class Engine:
 
     def _dev_decode_block(self, active, steps: int, fast_width=None,
                           mask_host=None):
+        self.metrics["decode_dispatches"] += 1
+        self.metrics["decode_steps_dispatched"] += steps
         self._bcast("decode_block", active=active, steps=steps,
                     fast_width=fast_width,
                     mask=None if mask_host is None else mask_host)
@@ -651,6 +662,9 @@ class Engine:
         return int(tok), float(lp)
 
     def _dev_spec_decode(self, active):
+        self.metrics["decode_dispatches"] += 1
+        # one spec dispatch fuses gamma draft steps + the verify pass
+        self.metrics["decode_steps_dispatched"] += self.ec.gamma + 1
         self._bcast("spec", active=active)
         with activate_mesh(self.mesh):
             (tokens_out, n_out, logprobs_out, self._next_tokens,

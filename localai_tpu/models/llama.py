@@ -269,9 +269,20 @@ def _qkv(x, lp, cfg: LlamaConfig):
 def _lm_head(x32, params):
     """Vocabulary projection in f32 (tied embeddings or separate, possibly
     int8-quantized, lm_head)."""
+    from localai_tpu.ops.quant import is_quantized
+
     head = params.get("lm_head", None)
     if head is None:
         return x32 @ params["embed"].astype(jnp.float32).T
+    if is_quantized(head):
+        # int8 values are exact in bf16, so a bf16×bf16 dot with f32
+        # accumulation loses only the f32→bf16 rounding of the activations —
+        # noise next to the int8 weight quantization — while halving the
+        # projection's HBM traffic vs dequant-to-f32 (2.2 ms → ~1 ms/step
+        # on v5e at the 128k vocab)
+        y = jnp.dot(x32.astype(jnp.bfloat16), head["q"].astype(jnp.bfloat16),
+                    preferred_element_type=jnp.float32)
+        return y * head["s"].astype(jnp.float32)
     return qmatmul(x32, head)
 
 

@@ -1,0 +1,72 @@
+"""Measure the tunneled-TPU dispatch/transfer round trip — the TTFT floor.
+
+A single-request TTFT on the idle engine is ~3 sequential device
+interactions (arg upload -> admit+decode execute -> token fetch); if the
+axon tunnel's RTT is hundreds of ms, TTFT is RTT-bound, not compute-bound.
+
+Prints: trivial-op round trip, small-upload round trip, small-download
+round trip, and a chained admit-shaped sequence.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def med(xs):
+    return sorted(xs)[len(xs) // 2]
+
+
+def main():
+    dev = jax.devices()[0]
+    print(f"device: {getattr(dev, 'device_kind', dev.platform)}")
+
+    f = jax.jit(lambda x: x + 1)
+    x = jnp.zeros((8, 128), jnp.float32)
+    f(x).block_until_ready()  # compile
+
+    # full round trip: dispatch trivial op + block
+    ts = []
+    for _ in range(20):
+        t0 = time.perf_counter()
+        f(x).block_until_ready()
+        ts.append((time.perf_counter() - t0) * 1e3)
+    print(f"dispatch+block roundtrip: p50 {med(ts):.1f} ms "
+          f"(min {min(ts):.1f}, max {max(ts):.1f})")
+
+    # host->device upload of a small buffer (admission ids-sized)
+    ids = np.zeros((8, 128), np.int32)
+    ts = []
+    for _ in range(20):
+        t0 = time.perf_counter()
+        jax.device_put(ids).block_until_ready()
+        ts.append((time.perf_counter() - t0) * 1e3)
+    print(f"small upload: p50 {med(ts):.1f} ms")
+
+    # device->host download (token fetch-sized)
+    y = f(x)
+    ts = []
+    for _ in range(20):
+        t0 = time.perf_counter()
+        np.asarray(y)
+        ts.append((time.perf_counter() - t0) * 1e3)
+    print(f"small download: p50 {med(ts):.1f} ms")
+
+    # chained: upload -> op -> download (one admit+decode+fetch shape)
+    ts = []
+    for _ in range(20):
+        t0 = time.perf_counter()
+        np.asarray(f(jax.device_put(ids).astype(jnp.float32)))
+        ts.append((time.perf_counter() - t0) * 1e3)
+    print(f"upload+op+download chain: p50 {med(ts):.1f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,14 +1,15 @@
 #!/bin/bash
-# TPU tunnel watcher — round 5 perf ladder.
+# TPU tunnel watcher — round 5 perf ladder (post-change edition).
 #
 # The axon tunnel drops for hours at a time (TPU_VALIDATION.md); this loop
 # probes until the chip answers, then runs the queued ladder:
 #   1. real-TPU kernel/engine tests
-#   2. serving bench, 16 slots (Pallas-engaged after the probe fix)
-#   3. serving bench, 32 slots over a paged KV pool
-#   4. decode step-time profile
-# Results land in bench_runs/; the loop exits once a bench reports a
-# non-cpu device, otherwise it retries every 3 min.
+#   2. serve bench, 16 slots (post batched-admission + bf16 lm_head)
+#   3. serve bench, 32 slots over a paged KV pool
+#   4. engine-mode bench, 32 slots paged vs dense (serve-vs-device split)
+#   5. attention slot sweep (dense vs paged kernel at B=8..48)
+# Results land in bench_runs/; the loop exits once the serve benches report
+# a non-cpu device, otherwise it retries every 3 min.
 cd /root/repo || exit 1
 mkdir -p bench_runs
 log() { echo "[$(date -u +%F" "%H:%M:%S)] $*" >> bench_runs/watch.log; }
@@ -18,24 +19,36 @@ while true; do
   if timeout 150 python -c "import jax; d=jax.devices()[0]; assert d.platform != 'cpu', d" 2>/dev/null; then
     log "tunnel up — starting ladder"
 
+    log "stage 0: tunnel RTT probe"
+    timeout 600 python tools/rtt_probe.py > bench_runs/rtt.log 2>&1
+    log "stage 0 rc=$? ($(grep roundtrip bench_runs/rtt.log | head -1))"
+
     log "stage 1: real-TPU tests"
     LOCALAI_TPU_TESTS=1 timeout 2400 python -m pytest tests/test_tpu_real.py -q \
       > bench_runs/tpu_tests.log 2>&1
     log "stage 1 rc=$? ($(tail -1 bench_runs/tpu_tests.log))"
 
-    log "stage 2: bench 16 slots"
-    timeout 3600 python bench.py > bench_runs/bench16.json 2> bench_runs/bench16.log
-    log "stage 2 rc=$? ($(cat bench_runs/bench16.json))"
+    log "stage 2: serve bench 16 slots (post-change)"
+    timeout 3600 python bench.py > bench_runs/bench16b.json 2> bench_runs/bench16b.log
+    log "stage 2 rc=$? ($(cat bench_runs/bench16b.json))"
 
-    log "stage 3: bench 32 slots, paged KV (320 blocks)"
+    log "stage 3: serve bench 32 slots, paged KV (320 blocks)"
     timeout 3600 python bench.py --slots 32 --kv-pages 320 \
-      > bench_runs/bench32.json 2> bench_runs/bench32.log
-    log "stage 3 rc=$? ($(cat bench_runs/bench32.json))"
+      > bench_runs/bench32b.json 2> bench_runs/bench32b.log
+    log "stage 3 rc=$? ($(cat bench_runs/bench32b.json))"
 
-    if grep -q '"device": "TPU' bench_runs/bench16.json bench_runs/bench32.json; then
-      log "stage 4: decode profile"
-      timeout 1800 python tools/profile_decode.py > bench_runs/profile.log 2>&1
-      log "stage 4 rc=$?"
+    if grep -q '"device": "TPU' bench_runs/bench16b.json bench_runs/bench32b.json; then
+      log "stage 4: engine-mode 32 paged / 32 dense"
+      timeout 1800 python bench.py --mode engine --slots 32 --kv-pages 320 \
+        > bench_runs/eng32p.json 2> bench_runs/eng32p.log
+      log "stage 4a rc=$? ($(cat bench_runs/eng32p.json))"
+      timeout 1800 python bench.py --mode engine --slots 32 \
+        > bench_runs/eng32d.json 2> bench_runs/eng32d.log
+      log "stage 4b rc=$? ($(cat bench_runs/eng32d.json))"
+
+      log "stage 5: attention sweep"
+      timeout 1800 python tools/profile_attn_sweep.py > bench_runs/attn_sweep.log 2>&1
+      log "stage 5 rc=$?"
       log "ladder complete"
       break
     fi
