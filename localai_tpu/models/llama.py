@@ -226,11 +226,24 @@ def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int, dtype=None,
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
 
-def _cache_write(kc, vc, k, v, rows, positions, table=None):
+def _cache_write(kc, vc, k, v, rows, positions, table=None, unique=True):
     """Scatter window K/V [B, S, KVH, D] into head-major caches [B', KVH, T, D]
     at (rows[b], :, positions[b, s]). With a paged `table` [B, MAXB] the cache
     is a block pool [NB, KVH, BS, D] and (slot, position) resolves to
-    (table[slot, pos // BS], :, pos % BS) — ops/paged.py layout."""
+    (table[slot, pos // BS], :, pos % BS) — ops/paged.py layout.
+
+    unique=True asserts the scatter rows never collide: decode/extend rows
+    target distinct slots (the engine dispatches one row per slot), and the
+    only collisions are redirected writes all landing on the paged TRASH
+    block (ops/paged.py) — never read, so their undefined contents are
+    harmless. The assertion matters because XLA cannot prove uniqueness of
+    table-gathered indices and otherwise falls off the in-place scatter
+    path — inside the layer scan that re-materializes the whole pool every
+    decode step (O(pool) per token). Batched admission passes unique=False:
+    _flush_admits pads groups by REPEATING a real request's plan, so its
+    readable rows DO collide there (identical values, but JAX calls the
+    result undefined under the assertion — don't lie to the compiler on
+    that path; admission is once per request, not per token)."""
     kvh = kc.shape[1]
     if table is None:
         idx = (rows[:, None, None], jnp.arange(kvh)[None, :, None],
@@ -242,10 +255,10 @@ def _cache_write(kc, vc, k, v, rows, positions, table=None):
         idx = (pb[:, None, :], jnp.arange(kvh)[None, :, None],
                (positions % BLOCK)[:, None, :])
     if isinstance(kc, QuantKV):
-        return (cache_scatter(kc, idx, k.transpose(0, 2, 1, 3)),
-                cache_scatter(vc, idx, v.transpose(0, 2, 1, 3)))
-    kc = kc.at[idx].set(k.transpose(0, 2, 1, 3))
-    vc = vc.at[idx].set(v.transpose(0, 2, 1, 3))
+        return (cache_scatter(kc, idx, k.transpose(0, 2, 1, 3), unique),
+                cache_scatter(vc, idx, v.transpose(0, 2, 1, 3), unique))
+    kc = kc.at[idx].set(k.transpose(0, 2, 1, 3), unique_indices=unique)
+    vc = vc.at[idx].set(v.transpose(0, 2, 1, 3), unique_indices=unique)
     return kc, vc
 
 
@@ -435,7 +448,10 @@ def prefill(params, cfg: LlamaConfig, tokens, lengths, cos, sin,
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         x = x + _mlp(h, lp, cfg)
         x = _shard_act(x, P("data", _seq_ax(), None))
-        kc, vc = _cache_write(kc, vc, k, v, slot_map, positions, table)
+        # unique=False: batched admission pads groups by repeating a real
+        # request's plan (engine _flush_admits), so slot_map can repeat
+        kc, vc = _cache_write(kc, vc, k, v, slot_map, positions, table,
+                              unique=False)
         return x, (kc, vc)
 
     x, (k_cache, v_cache) = jax.lax.scan(

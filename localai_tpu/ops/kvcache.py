@@ -111,17 +111,20 @@ def dequant(cache, dtype=jnp.bfloat16):
     return (cache.q.astype(jnp.float32) * s).astype(dtype)
 
 
-def cache_scatter(cache: QuantKV, idx, values) -> QuantKV:
+def cache_scatter(cache: QuantKV, idx, values, unique: bool = True) -> QuantKV:
     """Scatter dense token vectors into the quantized cache.
 
     idx: advanced-index tuple addressing [..., T] positions of the cache's
     lead+token axes (the same tuple the dense path hands to `.at[idx].set`);
-    values: matching [..., D] dense rows.
+    values: matching [..., D] dense rows. `unique` asserts non-colliding
+    rows (see models/llama.py _cache_write for when that holds) — the
+    assertion keeps XLA on the in-place scatter path inside the layer scan.
     """
     q, scale = quantize_tokens(values)
     *lead_idx, tok_idx = idx
     s_idx = (*lead_idx, tok_idx // SCALE_TILE, tok_idx % SCALE_TILE)
-    return QuantKV(cache.q.at[idx].set(q), cache.s.at[s_idx].set(scale))
+    return QuantKV(cache.q.at[idx].set(q, unique_indices=unique),
+                   cache.s.at[s_idx].set(scale, unique_indices=unique))
 
 
 def requantize(cache: QuantKV, dense) -> QuantKV:

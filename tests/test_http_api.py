@@ -480,8 +480,8 @@ def test_realtime_transcription_session(stack):
     base, _ = stack
     url = (base.replace("http://", "ws://")
            + "/v1/realtime?model=tiny&intent=transcription")
-    with connect(url, open_timeout=30) as ws:
-        first = json.loads(ws.recv(timeout=30))
+    with connect(url, open_timeout=120) as ws:
+        first = json.loads(ws.recv(timeout=120))
         assert first["type"] == "transcription_session.created"
         assert first["session"]["object"] == "realtime.transcription_session"
 
@@ -489,7 +489,7 @@ def test_realtime_transcription_session(stack):
         ws.send(json.dumps({"type": "input_audio_buffer.append",
                             "audio": base64.b64encode(b"\0\0" * 160).decode()}))
         ws.send(json.dumps({"type": "input_audio_buffer.clear"}))
-        assert json.loads(ws.recv(timeout=30))["type"] == \
+        assert json.loads(ws.recv(timeout=120))["type"] == \
             "input_audio_buffer.cleared"
 
         # commit synthesized speech → transcription events only
@@ -511,7 +511,7 @@ def test_realtime_transcription_session(stack):
 
         # responses are a conversation-session concept
         ws.send(json.dumps({"type": "response.create"}))
-        assert json.loads(ws.recv(timeout=30))["type"] == "error"
+        assert json.loads(ws.recv(timeout=120))["type"] == "error"
 
 
 def test_realtime_session_factory_routes(stack):

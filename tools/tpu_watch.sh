@@ -49,6 +49,12 @@ while true; do
       log "stage 5: attention sweep"
       timeout 1800 python tools/profile_attn_sweep.py > bench_runs/attn_sweep.log 2>&1
       log "stage 5 rc=$?"
+
+      log "stage 6: long-context serve (ctx 8192, 3968-token prompts, paged)"
+      timeout 3600 python bench.py --slots 16 --context 8192 \
+        --prompt-len 3968 --kv-pages 600 \
+        > bench_runs/bench8k.json 2> bench_runs/bench8k.log
+      log "stage 6 rc=$? ($(cat bench_runs/bench8k.json))"
       log "ladder complete"
       break
     fi
