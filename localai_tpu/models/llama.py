@@ -485,8 +485,21 @@ def decode_step(params, cfg: LlamaConfig, tokens, lengths, cos, sin,
     T = k_cache.shape[3] if table is None else table.shape[1] * 128
     _, attn_decode = _attn_impls(cfg, kv_quant=isinstance(k_cache, QuantKV))
     positions = lengths[:, None]  # [B,1]
-    wpos = positions if active is None else jnp.where(
-        active[:, None], positions, T - 1)
+    if active is None:
+        wpos = positions
+    elif table is None:
+        wpos = jnp.where(active[:, None], positions, T - 1)
+    else:
+        # paged: several inactive slots can share the TRASH block, so give
+        # each row a DISTINCT offset inside the last virtual block — the
+        # scatter stays genuinely collision-free (b <= 128 slots) and the
+        # unique_indices assertion below stays truthful. For a slot
+        # allocated to full context these offsets sit in its real last
+        # block, but only at positions its own prefill has not yet covered
+        # (lengths gate reads, and the prefill's write lands after).
+        off = T - 128 + (jnp.arange(b)[:, None] % 128)
+        wpos = jnp.where(active[:, None], positions, off)
+    unique = table is None or b <= 128
     x = params["embed"].astype(cfg.jdtype)[tokens][:, None, :]  # [B,1,H]
 
     def layer(x, xs):
@@ -495,7 +508,8 @@ def decode_step(params, cfg: LlamaConfig, tokens, lengths, cos, sin,
         q, k, v = _qkv(h, lp, cfg)
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
-        kc, vc = _cache_write(kc, vc, k, v, jnp.arange(b), wpos, table)
+        kc, vc = _cache_write(kc, vc, k, v, jnp.arange(b), wpos, table,
+                              unique=unique)
         attn = attn_decode(q, kc, vc, lengths + 1,
                            sliding_window=cfg.sliding_window, table=table)
         x = x + qmatmul(attn.reshape(b, 1, -1), lp["wo"])
@@ -569,7 +583,12 @@ def extend(params, cfg: LlamaConfig, tokens, start, cos, sin,
         q, k, v = _qkv(h, lp, cfg)
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
-        kc, vc = _cache_write(kc, vc, k, v, rows, positions, table)
+        # paged unique=False: a chunk window's padded tail positions can
+        # resolve to the same TRASH offsets with different values (e.g.
+        # positions p and p+128 past the slot's allocation) — a genuine
+        # collision, so the uniqueness assertion would be a lie here
+        kc, vc = _cache_write(kc, vc, k, v, rows, positions, table,
+                              unique=table is None)
         if table is not None:
             from localai_tpu.ops.paged import paged_view
 

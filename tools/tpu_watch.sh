@@ -3,13 +3,19 @@
 #
 # The axon tunnel drops for hours at a time (TPU_VALIDATION.md); this loop
 # probes until the chip answers, then runs the queued ladder:
+#   0. tunnel RTT probe                      (TTFT floor measurement)
 #   1. real-TPU kernel/engine tests
-#   2. serve bench, 16 slots (post batched-admission + bf16 lm_head)
-#   3. serve bench, 32 slots over a paged KV pool
-#   4. engine-mode bench, 32 slots paged vs dense (serve-vs-device split)
-#   5. attention slot sweep (dense vs paged kernel at B=8..48)
-# Results land in bench_runs/; the loop exits once the serve benches report
-# a non-cpu device, otherwise it retries every 3 min.
+#   2. serve bench, 16 slots                 (post batched-admission + bf16 lm_head)
+#   3. serve bench, 32 slots paged KV        (unique-scatter fix validation)
+#   -- gate: stages 2-3 must report a real TPU device, else retry --
+#   3b/3c. serve bench 32 / 48 slots DENSE int8 KV (headline-config search)
+#   4. engine-mode 32 paged vs dense         (serve-vs-device split)
+#   5. attention slot sweep                  (dense vs paged kernel B=8..48)
+#   6. long-context serve                    (ctx 8192, 3968-token prompts)
+#   7. decode step bisect                    (where the non-floor ms go)
+#   8. sampling profile                      (top_k vs approx_max_k)
+# Results land in bench_runs/; the loop exits after a full ladder on a real
+# device, otherwise it retries every 3 min.
 cd /root/repo || exit 1
 mkdir -p bench_runs
 log() { echo "[$(date -u +%F" "%H:%M:%S)] $*" >> bench_runs/watch.log; }
@@ -38,6 +44,16 @@ while true; do
     log "stage 3 rc=$? ($(cat bench_runs/bench32b.json))"
 
     if grep -q '"device": "TPU' bench_runs/bench16b.json bench_runs/bench32b.json; then
+      log "stage 3b: serve bench 32 slots DENSE int8 KV (fits: ~10.3 GB)"
+      timeout 3600 python bench.py --slots 32 \
+        > bench_runs/bench32d.json 2> bench_runs/bench32d.log
+      log "stage 3b rc=$? ($(cat bench_runs/bench32d.json))"
+
+      log "stage 3c: serve bench 48 slots DENSE int8 KV (~11.4 GB)"
+      timeout 3600 python bench.py --slots 48 \
+        > bench_runs/bench48d.json 2> bench_runs/bench48d.log
+      log "stage 3c rc=$? ($(cat bench_runs/bench48d.json))"
+
       log "stage 4: engine-mode 32 paged / 32 dense"
       timeout 1800 python bench.py --mode engine --slots 32 --kv-pages 320 \
         > bench_runs/eng32p.json 2> bench_runs/eng32p.log
@@ -55,6 +71,14 @@ while true; do
         --prompt-len 3968 --kv-pages 600 \
         > bench_runs/bench8k.json 2> bench_runs/bench8k.log
       log "stage 6 rc=$? ($(cat bench_runs/bench8k.json))"
+
+      log "stage 7: decode step bisect"
+      timeout 1800 python tools/profile_step_bisect.py > bench_runs/bisect.log 2>&1
+      log "stage 7 rc=$?"
+
+      log "stage 8: sampling profile"
+      timeout 1800 python tools/profile_sampling.py > bench_runs/sampling.log 2>&1
+      log "stage 8 rc=$?"
       log "ladder complete"
       break
     fi
