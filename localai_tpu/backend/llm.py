@@ -182,6 +182,13 @@ class LLMServicer(BackendServicer):
 
             self.embedder = Embedder(cfg, params, buckets=buckets, mesh=mesh)
             self.scorer = CrossScorer(cfg, params, buckets=buckets, mesh=mesh)
+        from localai_tpu.models.llava import is_llava, load_vision
+
+        self.vision = None
+        if is_llava(model_dir):
+            # vision-language checkpoint: the CLIP tower + projector serve
+            # request.images (the reference's mmproj / vLLM-multimodal role)
+            self.vision = load_vision(model_dir)
         self.cfg, self.tok = cfg, tok
         self.model_name = request.model
         self.engine.start()
@@ -243,6 +250,20 @@ class LLMServicer(BackendServicer):
         from localai_tpu.engine import GenRequest
 
         ids = self._prompt_ids(request, context)
+        mm_embeds = mm_positions = None
+        if request.images:
+            if self.vision is None:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                              "model has no vision tower; images unsupported")
+            try:
+                ids, mm_embeds, mm_positions = self._encode_images(
+                    ids, list(request.images))
+            except Exception as e:
+                # bad base64 (binascii.Error), not-an-image payloads
+                # (PIL.UnidentifiedImageError ⊂ OSError), placeholder-count
+                # mismatches (ValueError) — all client errors, never fatal
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                              f"bad image: {e}")
         req = GenRequest(
             prompt_ids=ids,
             params=self._sampling(request),
@@ -254,11 +275,39 @@ class LLMServicer(BackendServicer):
             context_shift=request.context_shift,
             prompt_cache_path=request.prompt_cache_path,
             prompt_cache_ro=request.prompt_cache_ro,
+            mm_embeds=mm_embeds,
+            mm_positions=mm_positions,
         )
         try:
             return self.engine.submit(req)
         except (ValueError, RuntimeError) as e:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+
+    def _encode_images(self, ids, images):
+        """b64 images + prompt ids with <image> placeholders → (expanded ids,
+        mm_embeds [K, H], mm_positions [K]). The CLIP tower + projector run
+        as their own jit — per-request prefill-side work, off the decode
+        loop (models/llava.py)."""
+        import numpy as np
+
+        from localai_tpu.models.llava import (
+            decode_image_b64, encode_images, expand_image_tokens,
+            preprocess_image,
+        )
+
+        vcfg, vparams, meta = self.vision
+        px = np.concatenate(
+            [preprocess_image(decode_image_b64(i), vcfg) for i in images])
+        feats = np.asarray(encode_images(vparams, vcfg, meta, px),
+                           np.float32)                  # [N, n_tok, H]
+        n_tok = feats.shape[1]
+        if meta.image_token_index not in ids and len(images) == 1:
+            # prompt without a placeholder (plain chat with an attachment):
+            # image goes first, like llava's "<image>\n<prompt>" convention
+            ids = [meta.image_token_index] + list(ids)
+        ids, positions = expand_image_tokens(
+            ids, len(images), n_tok, meta.image_token_index)
+        return ids, feats.reshape(-1, feats.shape[-1]), positions
 
     # ------------------------------------------------------------ inference
 

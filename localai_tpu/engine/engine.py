@@ -120,6 +120,11 @@ class GenRequest:
                                   # (reference PromptCachePath,
                                   # backend.proto:136-142)
     prompt_cache_ro: bool = False  # reuse only; never rewrite the file
+    # multimodal (models/llava.py): projected image features [K, H] f32 and
+    # the prompt positions they occupy (the expanded image-token slots) —
+    # injected into prefill instead of token embeddings
+    mm_embeds: Any = None          # np.ndarray [K, H] | None
+    mm_positions: Any = None       # np.ndarray [K] i64 | None
 
 
 @dataclasses.dataclass
@@ -370,7 +375,7 @@ class Engine:
 
         def _admit_many(params, cos, sin, kc, vc, sampler, last_logits,
                         lengths, tokens, lens, slots, rows, counts_rows,
-                        table=None):
+                        table=None, inject=None):
             """Admission burst: prefill K same-bucket requests in ONE pass.
 
             The single-request _admit streams the full weight set per call —
@@ -380,7 +385,8 @@ class Engine:
             reference can't do this — llama.cpp prefills slots one ubatch at
             a time, grpc-server.cpp update_slots)."""
             logits, kc, vc = prefill(
-                params, cfg, tokens, lens, cos, sin, kc, vc, slots, table
+                params, cfg, tokens, lens, cos, sin, kc, vc, slots, table,
+                inject
             )
             last_logits = last_logits.at[slots].set(logits)
             lengths = lengths.at[slots].set(lens)
@@ -388,23 +394,27 @@ class Engine:
             return kc, vc, sampler, last_logits, lengths
 
         def _extend_mid(params, cos, sin, kc, vc, tokens, start, slot,
-                        table=None):
-            """One non-final prefill chunk: KV writes only."""
+                        table=None, inject=None):
+            """One non-final prefill chunk: KV writes only. Mid chunks are
+            always full (the final chunk takes _extend_final), so every
+            position sits inside the slot's allocation → full_window keeps
+            the paged scatter on the asserted-unique in-place path."""
             _, kc, vc = extend(params, cfg, tokens, start[None], cos, sin,
                                kc, vc, slot_map=slot[None], with_logits=False,
-                               table=table)
+                               table=table, inject=inject, full_window=True)
             return kc, vc
 
         def _extend_final(params, cos, sin, kc, vc, sampler, last_logits,
                           lengths, tokens, start, nvalid, slot, row,
-                          counts_row, table=None):
+                          counts_row, table=None, inject=None):
             """Final prefill chunk: KV writes + last-token logits + sampler
             row install (deferred to here so the request's RNG stream is
             independent of how many engine ticks the prefill spanned)."""
             logits, kc, vc = extend(
                 params, cfg, tokens, start[None], cos, sin, kc, vc,
                 slot_map=slot[None],
-                last_pos=jnp.maximum(nvalid - 1, 0)[None], table=table)
+                last_pos=jnp.maximum(nvalid - 1, 0)[None], table=table,
+                inject=inject)
             last_logits = last_logits.at[slot].set(logits[0])
             lengths = lengths.at[slot].set(start + nvalid)
             sampler = _install_row(sampler, slot, row, counts_row)
@@ -546,20 +556,22 @@ class Engine:
         the single source of truth with no donation bookkeeping."""
         return jnp.asarray(self._table) if self._paged else None
 
-    def _dev_admit(self, ids, n, slot, row, counts_row):
+    def _dev_admit(self, ids, n, slot, row, counts_row, inject=None):
         # single admission == the K=1 batched case (the delegate broadcasts
         # "admit_many"; the "admit" follower op is kept for replay compat)
         self._dev_admit_many(
             np.asarray(ids, np.int32), np.asarray([n], np.int32),
             np.asarray([slot], np.int32),
             {k: np.asarray(v)[None] for k, v in row.items()},
-            None if counts_row is None else np.asarray(counts_row)[None])
+            None if counts_row is None else np.asarray(counts_row)[None],
+            inject)
 
-    def _dev_admit_many(self, ids, lens, slots, rows, counts_rows):
+    def _dev_admit_many(self, ids, lens, slots, rows, counts_rows,
+                        inject=None):
         self.metrics["admit_dispatches"] += 1
         self._bcast("admit_many", ids=ids, lens=lens, slots=slots,
                     rows={k: np.asarray(v) for k, v in rows.items()},
-                    counts_rows=counts_rows)
+                    counts_rows=counts_rows, inject=self._inj_msg(inject))
         with activate_mesh(self.mesh):
             (self._kc, self._vc, self._sampler, self._last_logits,
              self._lengths) = self._admit_many_fn(
@@ -569,19 +581,46 @@ class Engine:
                 jnp.asarray(ids), jnp.asarray(lens), jnp.asarray(slots),
                 {k: jnp.asarray(v) for k, v in rows.items()},
                 None if counts_rows is None else jnp.asarray(counts_rows),
-                self._tab())
+                self._tab(), self._inj(inject))
 
-    def _dev_extend_mid(self, buf, pos, idx):
-        self._bcast("extend_mid", buf=buf, pos=pos, idx=idx)
+    @staticmethod
+    def _inj(inject):
+        """Host inject pair (extra [B,S,H] f32, is_embed [B,S] bool) → device
+        arrays (None passes through; jit specializes the text-only variant)."""
+        if inject is None:
+            return None
+        extra, is_embed = inject
+        return (jnp.asarray(extra), jnp.asarray(is_embed))
+
+    @staticmethod
+    def _inj_msg(inject):
+        """inject pair → broadcast-safe dict (the _bcast serializer would
+        np.asarray a tuple, which fails on mismatched member shapes)."""
+        if inject is None:
+            return None
+        return {"extra": np.asarray(inject[0]), "mask": np.asarray(inject[1])}
+
+    @staticmethod
+    def _inj_of(msg):
+        """_inj_msg's inverse, for follower replay."""
+        if msg is None:
+            return None
+        return (msg["extra"], msg["mask"])
+
+    def _dev_extend_mid(self, buf, pos, idx, inject=None):
+        self._bcast("extend_mid", buf=buf, pos=pos, idx=idx,
+                    inject=self._inj_msg(inject))
         with activate_mesh(self.mesh):
             self._kc, self._vc = self._extend_mid_fn(
                 self.params, self._cos, self._sin, self._kc, self._vc,
-                jnp.asarray(buf), jnp.int32(pos), jnp.int32(idx), self._tab())
+                jnp.asarray(buf), jnp.int32(pos), jnp.int32(idx), self._tab(),
+                self._inj(inject))
 
-    def _dev_extend_final(self, buf, pos, nvalid, idx, row, counts_row):
+    def _dev_extend_final(self, buf, pos, nvalid, idx, row, counts_row,
+                          inject=None):
         self._bcast("extend_final", buf=buf, pos=pos, nvalid=nvalid, idx=idx,
                     row={k: np.asarray(v) for k, v in row.items()},
-                    counts_row=counts_row)
+                    counts_row=counts_row, inject=self._inj_msg(inject))
         with activate_mesh(self.mesh):
             (self._kc, self._vc, self._sampler, self._last_logits,
              self._lengths) = self._extend_final_fn(
@@ -591,7 +630,7 @@ class Engine:
                 jnp.int32(nvalid), jnp.int32(idx),
                 {k: jnp.asarray(v) for k, v in row.items()},
                 None if counts_row is None else jnp.asarray(counts_row),
-                self._tab())
+                self._tab(), self._inj(inject))
 
     def _dev_decode(self, active, mask_host=None, fast_width=None):
         self.metrics["decode_dispatches"] += 1
@@ -705,12 +744,15 @@ class Engine:
                             kw["counts_row"])
         elif op == "admit_many":
             self._dev_admit_many(kw["ids"], kw["lens"], kw["slots"],
-                                 kw["rows"], kw["counts_rows"])
+                                 kw["rows"], kw["counts_rows"],
+                                 self._inj_of(kw.get("inject")))
         elif op == "extend_mid":
-            self._dev_extend_mid(kw["buf"], kw["pos"], kw["idx"])
+            self._dev_extend_mid(kw["buf"], kw["pos"], kw["idx"],
+                                 self._inj_of(kw.get("inject")))
         elif op == "extend_final":
             self._dev_extend_final(kw["buf"], kw["pos"], kw["nvalid"],
-                                   kw["idx"], kw["row"], kw["counts_row"])
+                                   kw["idx"], kw["row"], kw["counts_row"],
+                                   self._inj_of(kw.get("inject")))
         elif op == "decode":
             self._dev_decode(kw["active"], kw["mask"],
                              kw.get("fast_width"))
@@ -748,6 +790,25 @@ class Engine:
             raise ValueError(
                 "grammar-constrained decoding is not supported with a "
                 "draft model (the grammar mask must advance per token)")
+        if req.mm_embeds is not None:
+            if self._draft is not None:
+                raise ValueError(
+                    "multimodal prompts are not supported with a draft "
+                    "model (the draft has no vision tower)")
+            emb = np.asarray(req.mm_embeds, np.float32)
+            pos = np.asarray(req.mm_positions, np.int64)
+            if emb.ndim != 2 or emb.shape[1] != self.cfg.hidden_size:
+                raise ValueError(
+                    f"mm_embeds must be [K, {self.cfg.hidden_size}], got "
+                    f"{emb.shape}")
+            if pos.shape != (emb.shape[0],):
+                raise ValueError("mm_positions must match mm_embeds rows")
+            if len(pos) and (pos.min() < 0
+                             or pos.max() >= len(req.prompt_ids)):
+                raise ValueError("mm_positions outside the prompt")
+            if len(pos) > 1 and (np.diff(pos) <= 0).any():
+                raise ValueError("mm_positions must be strictly increasing")
+            req.mm_embeds, req.mm_positions = emb, pos
         if req.context_shift and self._draft is not None:
             raise ValueError(
                 "context_shift is not supported with a draft model "
@@ -822,7 +883,10 @@ class Engine:
                 prompt_tokens=len(req.prompt_ids),
             ))
             return False
-        slot, lcp = self._pick_slot(req.prompt_ids)
+        mm = req.mm_embeds is not None
+        # multimodal: id-level prefix reuse would match the repeated image
+        # token while the injected features differ — no slot or disk reuse
+        slot, lcp = self._pick_slot([] if mm else req.prompt_ids)
         if self._paged and not self._alloc_slot(slot, req):
             # pool exhausted even after reclaim: defer (FIFO) until blocks
             # free — the caller re-attempts on later ticks
@@ -831,7 +895,7 @@ class Engine:
             return None
         self._slot_kv_tokens[slot] = []
         disk_prefix = 0
-        if not lcp and req.prompt_cache_path:
+        if not lcp and req.prompt_cache_path and not mm:
             lcp = disk_prefix = self._load_prompt_cache(slot, req)
         if lcp:
             # shared prefix already in this slot's cache: prefill only the
@@ -856,7 +920,7 @@ class Engine:
             counts_row = None
 
         if not chunked:
-            if batch is not None and self._draft is None:
+            if batch is not None and self._draft is None and not mm:
                 # defer the device call: _flush_admits batches same-bucket
                 # admissions from this tick into one prefill pass
                 batch.append(dict(slot=slot, n=n, bucket=bucket,
@@ -865,7 +929,8 @@ class Engine:
             else:
                 ids = self._pad_ids([dict(n=n, prompt_ids=req.prompt_ids)],
                                     bucket)
-                self._dev_admit(ids, n, slot, row, counts_row)
+                inject = self._mm_inject(req, 0, bucket) if mm else None
+                self._dev_admit(ids, n, slot, row, counts_row, inject)
                 if self._draft is not None:
                     self._dev_draft_ingest(ids, 0, slot)
 
@@ -926,11 +991,13 @@ class Engine:
                 buf = np.zeros((1, self._chunk), np.int32)
                 buf[0, :nvalid] = ids[pos:pos + nvalid]
                 final = pos + nvalid == len(ids)
+                inject = (self._mm_inject(slot.req, pos, self._chunk)
+                          if slot.req.mm_embeds is not None else None)
                 if final:
                     self._dev_extend_final(buf, pos, nvalid, idx, slot.row,
-                                           slot.counts_row)
+                                           slot.counts_row, inject)
                 else:
-                    self._dev_extend_mid(buf, pos, idx)
+                    self._dev_extend_mid(buf, pos, idx, inject)
                 if self._draft is not None:
                     self._dev_draft_ingest(buf, pos, idx)
                 slot.prefill_pos = pos + nvalid
@@ -966,6 +1033,21 @@ class Engine:
                 return
 
     _ADMIT_GROUP_SIZES = (2, 4, 8)
+
+    @staticmethod
+    def _mm_inject(req: GenRequest, start: int, width: int):
+        """(extra [1, width, H] f32, mask [1, width] bool) for the prompt
+        window [start, start+width): image-feature rows from req.mm_embeds
+        land at their expanded positions, everything else stays a token."""
+        pos, emb = req.mm_positions, req.mm_embeds
+        lo = int(np.searchsorted(pos, start))
+        hi = int(np.searchsorted(pos, start + width))
+        extra = np.zeros((1, width, emb.shape[1]), np.float32)
+        mask = np.zeros((1, width), bool)
+        sel = (pos[lo:hi] - start).astype(np.int64)
+        extra[0, sel] = emb[lo:hi]
+        mask[0, sel] = True
+        return (extra, mask)
 
     @staticmethod
     def _pad_ids(plans: list, bucket: int) -> np.ndarray:
@@ -1439,7 +1521,10 @@ class Engine:
         cache file (skipped for RO requests, meshes, shifted slots)."""
         if (not slot.req.prompt_cache_path or slot.req.prompt_cache_ro
                 or not self._cache_addressable or self._draft is not None
-                or self._paged or slot.shifted or not slot.prefilled):
+                or self._paged or slot.shifted or not slot.prefilled
+                or slot.req.mm_embeds is not None):
+            # (mm: no reuse path can load it, and the repeated image-token
+            # ids could positionally match a text prompt — see _release_slot)
             return
         n = min(slot.prompt_len, self.ec.max_context - 2)
         if slot.disk_prefix >= n - 1:
@@ -1509,8 +1594,11 @@ class Engine:
         # record what this slot's cache still holds (valid rows 0..len-1) so
         # a future prompt sharing the prefix skips that part of its prefill.
         # Shifted slots moved rows — their mapping is no longer positional.
+        # (multimodal prompts excluded: their image-token ids all look alike
+        # while the injected embeddings differ per image, so positional
+        # prefix-matching on ids would reuse the WRONG image's KV)
         if (self.ec.prompt_cache and self._draft is None
-                and slot.shifted == 0):
+                and slot.shifted == 0 and slot.req.mm_embeds is None):
             kept = (list(slot.req.prompt_ids) + slot.gen_ids)[
                 : self.ec.max_context - 2]
             self._slot_kv_tokens[idx] = kept

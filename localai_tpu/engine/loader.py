@@ -39,6 +39,16 @@ def load_config(model_dir: str, dtype: str | None = None) -> LlamaConfig:
         hf: dict[str, Any] = json.load(f)
 
     arch = (hf.get("architectures") or ["LlamaForCausalLM"])[0]
+    if hf.get("model_type") == "llava" or arch.startswith("Llava"):
+        # vision-language checkpoint: the language side is a plain
+        # Llama-family config nested under text_config (the vision side
+        # loads separately — models/llava.py)
+        hf = dict(hf["text_config"])
+        arch = (hf.get("architectures")
+                or [{"llama": "LlamaForCausalLM",
+                     "mistral": "MistralForCausalLM",
+                     "qwen2": "Qwen2ForCausalLM"}.get(
+                        hf.get("model_type", "llama"), "LlamaForCausalLM")])[0]
     if arch not in LLAMA_FAMILY:
         raise ValueError(f"unsupported architecture {arch!r}")
     extra = LLAMA_FAMILY[arch]
@@ -156,14 +166,33 @@ class _TensorReader:
                 f.close()
         raise FileNotFoundError(f"no safetensors checkpoint in {model_dir}")
 
+    @staticmethod
+    def _variants(name: str):
+        """Key spellings across HF save layouts: plain Llama, classic LLaVA
+        (language_model.model.* + language_model.lm_head.*), and the 4.52+
+        LLaVA relayout (model.language_model.* + top-level lm_head.*)."""
+        yield name
+        yield "language_model." + name
+        if name.startswith("model."):
+            yield "model.language_model." + name[len("model."):]
+
+    def _resolve(self, name: str) -> str | None:
+        for v in self._variants(name):
+            if v in self.index:
+                return v
+        return None
+
     def __contains__(self, name: str) -> bool:
-        return name in self.index
+        return self._resolve(name) is not None
 
     def get(self, name: str) -> np.ndarray:
-        fname = self.index[name]
+        key = self._resolve(name)
+        if key is None:
+            raise KeyError(name)
+        fname = self.index[key]
         if fname not in self._open:
             self._open[fname] = _SafetensorsFile(os.path.join(self.dir, fname))
-        return self._open[fname].get(name)
+        return self._open[fname].get(key)
 
     def close(self):
         for f in self._open.values():

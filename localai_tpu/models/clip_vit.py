@@ -1,0 +1,210 @@
+"""CLIP ViT vision tower in JAX — the eyes of the multimodal chat path.
+
+Reference parity: LocalAI serves vision-language chat through llama.cpp's
+mmproj CLIP encoder (/root/reference/backend/cpp/llama-cpp/grpc-server.cpp:285-289
+loads the mmproj GGUF) and vLLM/mlx-vlm multimodal inputs
+(/root/reference/backend/python/vllm/backend.py:232-252). Here the tower is
+the HF `CLIPVisionModel` layout run as a stacked-layer lax.scan — one
+compiled block, MXU-shaped matmuls — feeding the LLaVA projector
+(models/llava.py).
+
+Layout notes (HF transformers):
+- patch conv [H, 3, P, P], stride P, no bias → as a matmul over flattened
+  patches (a P×P conv with stride P IS a linear map per patch — matmul is
+  the MXU-native spelling).
+- class embedding prepended, learned position embeddings added.
+- "pre_layrnorm" (sic — HF's historical typo) before the encoder.
+- pre-LN transformer blocks, quick_gelu (x·σ(1.702x)) MLP.
+- LLaVA reads hidden_states[-2] (vision_feature_layer) and drops the CLS
+  row (vision_feature_select_strategy="default"), so the final
+  post_layernorm is NOT applied to the features we return.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from localai_tpu.ops.norms import layer_norm
+
+
+@dataclasses.dataclass(frozen=True)
+class ClipVisionConfig:
+    hidden_size: int = 1024
+    intermediate_size: int = 4096
+    num_layers: int = 24
+    num_heads: int = 16
+    image_size: int = 336
+    patch_size: int = 14
+    layer_norm_eps: float = 1e-5
+    dtype: str = "float32"
+
+    @property
+    def jdtype(self):
+        return jnp.dtype(self.dtype)
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @staticmethod
+    def from_hf(hf: dict[str, Any], dtype: str | None = None):
+        return ClipVisionConfig(
+            hidden_size=hf.get("hidden_size", 1024),
+            intermediate_size=hf.get("intermediate_size", 4096),
+            num_layers=hf.get("num_hidden_layers", 24),
+            num_heads=hf.get("num_attention_heads", 16),
+            image_size=hf.get("image_size", 336),
+            patch_size=hf.get("patch_size", 14),
+            layer_norm_eps=hf.get("layer_norm_eps", 1e-5),
+            dtype=dtype or "float32",
+        )
+
+
+# CLIP pixel normalization (OpenAI checkpoints; HF CLIPImageProcessor)
+IMAGE_MEAN = np.array([0.48145466, 0.4578275, 0.40821073], np.float32)
+IMAGE_STD = np.array([0.26862954, 0.26130258, 0.27577711], np.float32)
+
+
+def preprocess_image(data: bytes, cfg: ClipVisionConfig) -> np.ndarray:
+    """Image bytes → pixel_values [1, 3, S, S] f32 (resize + CLIP normalize).
+    Matches CLIPImageProcessor's square resize (llava's processor does a
+    bicubic resize to image_size on both axes)."""
+    import io
+
+    from PIL import Image
+
+    img = Image.open(io.BytesIO(data)).convert("RGB")
+    img = img.resize((cfg.image_size, cfg.image_size), Image.BICUBIC)
+    x = np.asarray(img, np.float32) / 255.0                    # [S, S, 3]
+    x = (x - IMAGE_MEAN) / IMAGE_STD
+    return x.transpose(2, 0, 1)[None]                          # [1, 3, S, S]
+
+
+def vision_forward(params, cfg: ClipVisionConfig, pixel_values,
+                   feature_layer: int = -2):
+    """pixel_values [B, 3, S, S] → hidden states [B, 1 + N, H] at
+    `feature_layer` (counted like HF hidden_states: -1 = after the last
+    block, -2 = after the second-to-last). CLS row included; callers slice.
+    """
+    x = jnp.asarray(pixel_values, cfg.jdtype)
+    b = x.shape[0]
+    p = cfg.patch_size
+    g = cfg.image_size // p
+    # [B, 3, G, p, G, p] → [B, G*G, 3*p*p]: each patch flattened exactly in
+    # the conv-kernel element order (channel-major), so the matmul below is
+    # bit-equivalent to HF's stride-P conv
+    x = x.reshape(b, 3, g, p, g, p).transpose(0, 2, 4, 1, 3, 5)
+    x = x.reshape(b, g * g, 3 * p * p)
+    x = x @ params["patch_embed"]                              # [B, N, H]
+    cls = jnp.broadcast_to(params["class_embed"], (b, 1, cfg.hidden_size))
+    x = jnp.concatenate([cls.astype(x.dtype), x], axis=1)      # [B, 1+N, H]
+    x = x + params["pos_embed"]
+    x = layer_norm(x, params["pre_ln_w"], params["pre_ln_b"],
+                   cfg.layer_norm_eps)
+
+    n_run = cfg.num_layers + 1 + feature_layer if feature_layer < 0 \
+        else feature_layer
+    nh = cfg.num_heads
+    hd = cfg.hidden_size // nh
+    scale = hd ** -0.5
+
+    def block(x, lp):
+        h = layer_norm(x, lp["ln1_w"], lp["ln1_b"], cfg.layer_norm_eps)
+        q = (h @ lp["wq"] + lp["bq"]).reshape(b, -1, nh, hd)
+        k = (h @ lp["wk"] + lp["bk"]).reshape(b, -1, nh, hd)
+        v = (h @ lp["wv"] + lp["bv"]).reshape(b, -1, nh, hd)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q * scale, k)
+        a = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(x.dtype)
+        o = jnp.einsum("bhqk,bkhd->bqhd", a, v).reshape(b, -1, nh * hd)
+        x = x + (o @ lp["wo"] + lp["bo"])
+        h = layer_norm(x, lp["ln2_w"], lp["ln2_b"], cfg.layer_norm_eps)
+        h = h @ lp["fc1"] + lp["b1"]
+        h = h * jax.nn.sigmoid(1.702 * h)                      # quick_gelu
+        x = x + (h @ lp["fc2"] + lp["b2"])
+        return x, None
+
+    sliced = jax.tree_util.tree_map(lambda t: t[:n_run], params["layers"])
+    x, _ = jax.lax.scan(block, x, sliced)
+    return x
+
+
+def init_vision_params(cfg: ClipVisionConfig, key):
+    """Random init with the load_vision_params layout (tests)."""
+    ks = jax.random.split(key, 4)
+    H, I, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    pdim = 3 * cfg.patch_size ** 2
+    dt = cfg.jdtype
+
+    def norm(k, shape, fan):
+        return (jax.random.normal(k, shape, jnp.float32) * fan ** -0.5
+                ).astype(dt)
+
+    layers = {
+        "ln1_w": jnp.ones((L, H), dt), "ln1_b": jnp.zeros((L, H), dt),
+        "wq": norm(ks[0], (L, H, H), H), "bq": jnp.zeros((L, H), dt),
+        "wk": norm(ks[1], (L, H, H), H), "bk": jnp.zeros((L, H), dt),
+        "wv": norm(ks[2], (L, H, H), H), "bv": jnp.zeros((L, H), dt),
+        "wo": norm(ks[3], (L, H, H), H), "bo": jnp.zeros((L, H), dt),
+        "ln2_w": jnp.ones((L, H), dt), "ln2_b": jnp.zeros((L, H), dt),
+        "fc1": norm(ks[0], (L, H, I), H), "b1": jnp.zeros((L, I), dt),
+        "fc2": norm(ks[1], (L, I, H), I), "b2": jnp.zeros((L, H), dt),
+    }
+    return {
+        "patch_embed": norm(ks[2], (pdim, H), pdim),
+        "class_embed": norm(ks[3], (H,), H),
+        "pos_embed": norm(ks[0], (1 + cfg.n_patches, H), H),
+        "pre_ln_w": jnp.ones((H,), dt), "pre_ln_b": jnp.zeros((H,), dt),
+        "layers": layers,
+    }
+
+
+def load_vision_params(reader, cfg: ClipVisionConfig, *, prefix: str,
+                       dtype=None):
+    """HF CLIPVisionModel weights → our layout. `reader` is an
+    engine.loader._TensorReader; `prefix` is e.g. "vision_tower." or
+    "model.vision_tower." (both LLaVA save layouts)."""
+    def get(name):
+        t = reader.get(prefix + "vision_model." + name)
+        return np.asarray(t, np.float32)
+
+    L = cfg.num_layers
+    lay = "encoder.layers.{i}."
+
+    def stack(fmt, transpose):
+        ts = [get(fmt.format(i=i)) for i in range(L)]
+        return np.stack([t.T if transpose else t for t in ts])
+
+    layers = {
+        "ln1_w": stack(lay + "layer_norm1.weight", False),
+        "ln1_b": stack(lay + "layer_norm1.bias", False),
+        "wq": stack(lay + "self_attn.q_proj.weight", True),
+        "bq": stack(lay + "self_attn.q_proj.bias", False),
+        "wk": stack(lay + "self_attn.k_proj.weight", True),
+        "bk": stack(lay + "self_attn.k_proj.bias", False),
+        "wv": stack(lay + "self_attn.v_proj.weight", True),
+        "bv": stack(lay + "self_attn.v_proj.bias", False),
+        "wo": stack(lay + "self_attn.out_proj.weight", True),
+        "bo": stack(lay + "self_attn.out_proj.bias", False),
+        "ln2_w": stack(lay + "layer_norm2.weight", False),
+        "ln2_b": stack(lay + "layer_norm2.bias", False),
+        "fc1": stack(lay + "mlp.fc1.weight", True),
+        "b1": stack(lay + "mlp.fc1.bias", False),
+        "fc2": stack(lay + "mlp.fc2.weight", True),
+        "b2": stack(lay + "mlp.fc2.bias", False),
+    }
+    conv = get("embeddings.patch_embedding.weight")  # [H, 3, P, P]
+    patch = conv.reshape(conv.shape[0], -1).T        # [3*P*P, H]
+    params = {
+        "patch_embed": patch,
+        "class_embed": get("embeddings.class_embedding"),
+        "pos_embed": get("embeddings.position_embedding.weight"),
+        "pre_ln_w": get("pre_layrnorm.weight"),
+        "pre_ln_b": get("pre_layrnorm.bias"),
+        "layers": layers,
+    }
+    jdt = jnp.dtype(cfg.dtype)
+    return jax.tree_util.tree_map(lambda t: jnp.asarray(t, jdt), params)

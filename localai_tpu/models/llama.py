@@ -226,24 +226,30 @@ def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int, dtype=None,
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
 
-def _cache_write(kc, vc, k, v, rows, positions, table=None, unique=True):
+def _cache_write(kc, vc, k, v, rows, positions, table=None, unique=True,
+                 redirect=None):
     """Scatter window K/V [B, S, KVH, D] into head-major caches [B', KVH, T, D]
     at (rows[b], :, positions[b, s]). With a paged `table` [B, MAXB] the cache
     is a block pool [NB, KVH, BS, D] and (slot, position) resolves to
     (table[slot, pos // BS], :, pos % BS) — ops/paged.py layout.
 
-    unique=True asserts the scatter rows never collide: decode/extend rows
-    target distinct slots (the engine dispatches one row per slot), and the
-    only collisions are redirected writes all landing on the paged TRASH
-    block (ops/paged.py) — never read, so their undefined contents are
-    harmless. The assertion matters because XLA cannot prove uniqueness of
-    table-gathered indices and otherwise falls off the in-place scatter
-    path — inside the layer scan that re-materializes the whole pool every
-    decode step (O(pool) per token). Batched admission passes unique=False:
-    _flush_admits pads groups by REPEATING a real request's plan, so its
-    readable rows DO collide there (identical values, but JAX calls the
-    result undefined under the assertion — don't lie to the compiler on
-    that path; admission is once per request, not per token)."""
+    redirect [B] bool (paged only): rows flagged True write to the TRASH
+    block (physical 0, ops/paged.py) at offset row%BLOCK instead of through
+    their table — the inactive-slot decode redirect. Routing by PHYSICAL
+    block keeps the garbage out of every real block (a slot's own table can
+    map its last virtual block to a RETAINED warm-prefix block), and the
+    per-row offsets keep the scatter collision-free.
+
+    unique=True asserts the scatter rows never collide: decode rows target
+    distinct slots (one row per slot; redirected rows get distinct trash
+    offsets), so the assertion holds and keeps XLA on the in-place scatter
+    path — without it the table-gathered indices are unprovably unique and
+    the layer scan re-materializes the whole pool every decode step
+    (O(pool) per token). Callers pass unique=False when collisions are
+    REAL: batched admission pads groups by repeating a plan
+    (engine._flush_admits), and a final prefill chunk's padded tail
+    positions resolve to shared trash offsets — don't lie to the compiler
+    on those paths (both are per-request, not per-token)."""
     kvh = kc.shape[1]
     if table is None:
         idx = (rows[:, None, None], jnp.arange(kvh)[None, :, None],
@@ -252,8 +258,12 @@ def _cache_write(kc, vc, k, v, rows, positions, table=None, unique=True):
         from localai_tpu.ops.paged import BLOCK
 
         pb = table[rows[:, None], positions // BLOCK]      # [B, S] physical
+        off = positions % BLOCK
+        if redirect is not None:
+            pb = jnp.where(redirect[:, None], 0, pb)
+            off = jnp.where(redirect[:, None], (rows % BLOCK)[:, None], off)
         idx = (pb[:, None, :], jnp.arange(kvh)[None, :, None],
-               (positions % BLOCK)[:, None, :])
+               off[:, None, :])
     if isinstance(kc, QuantKV):
         return (cache_scatter(kc, idx, k.transpose(0, 2, 1, 3), unique),
                 cache_scatter(vc, idx, v.transpose(0, 2, 1, 3), unique))
@@ -422,18 +432,24 @@ def _attn_impls(cfg: LlamaConfig | None = None, kv_quant: bool = False):
 
 
 def prefill(params, cfg: LlamaConfig, tokens, lengths, cos, sin,
-            k_cache, v_cache, slot_map, table=None):
+            k_cache, v_cache, slot_map, table=None, inject=None):
     """Process padded prompt batch, writing K/V into slot rows of the cache.
 
     tokens: [B, S] i32 (padded); lengths: [B]; slot_map: [B] i32 — which cache
     slot each batch row writes into; cos/sin: rope tables; table: optional
-    paged block table (ops/paged.py).
+    paged block table (ops/paged.py). inject (extra [B, S, H], is_embed
+    [B, S] bool), optional: positions with is_embed take `extra` rows instead
+    of the token embedding — the multimodal path (models/llava.py) splices
+    projected image features into the prompt here.
     Returns (last_token_logits [B, V] f32, k_cache, v_cache).
     """
     b, s = tokens.shape
     attn_prefill, _ = _attn_impls(cfg)
     positions = jnp.arange(s)[None, :].repeat(b, 0)
     x = params["embed"].astype(cfg.jdtype)[tokens]
+    if inject is not None:
+        extra, is_embed = inject
+        x = jnp.where(is_embed[..., None], extra.astype(x.dtype), x)
     x = _shard_act(x, P("data", _seq_ax(), None))
 
     def layer(x, xs):
@@ -486,19 +502,16 @@ def decode_step(params, cfg: LlamaConfig, tokens, lengths, cos, sin,
     _, attn_decode = _attn_impls(cfg, kv_quant=isinstance(k_cache, QuantKV))
     positions = lengths[:, None]  # [B,1]
     if active is None:
-        wpos = positions
+        wpos, redirect = positions, None
     elif table is None:
-        wpos = jnp.where(active[:, None], positions, T - 1)
+        # dense: each row owns its slot row, so T-1 (never readable — the
+        # engine terminates at max_context-2) is a safe per-row target
+        wpos, redirect = jnp.where(active[:, None], positions, T - 1), None
     else:
-        # paged: several inactive slots can share the TRASH block, so give
-        # each row a DISTINCT offset inside the last virtual block — the
-        # scatter stays genuinely collision-free (b <= 128 slots) and the
-        # unique_indices assertion below stays truthful. For a slot
-        # allocated to full context these offsets sit in its real last
-        # block, but only at positions its own prefill has not yet covered
-        # (lengths gate reads, and the prefill's write lands after).
-        off = T - 128 + (jnp.arange(b)[:, None] % 128)
-        wpos = jnp.where(active[:, None], positions, off)
+        # paged: inactive rows write to the trash block at distinct per-row
+        # offsets (_cache_write redirect) — never through their own table,
+        # whose last virtual block can be a RETAINED warm-prefix block
+        wpos, redirect = positions, ~active
     unique = table is None or b <= 128
     x = params["embed"].astype(cfg.jdtype)[tokens][:, None, :]  # [B,1,H]
 
@@ -509,7 +522,7 @@ def decode_step(params, cfg: LlamaConfig, tokens, lengths, cos, sin,
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
         kc, vc = _cache_write(kc, vc, k, v, jnp.arange(b), wpos, table,
-                              unique=unique)
+                              unique=unique, redirect=redirect)
         attn = attn_decode(q, kc, vc, lengths + 1,
                            sliding_window=cfg.sliding_window, table=table)
         x = x + qmatmul(attn.reshape(b, 1, -1), lp["wo"])
@@ -556,7 +569,7 @@ def hidden_states(params, cfg: LlamaConfig, tokens, lengths=None):
 
 def extend(params, cfg: LlamaConfig, tokens, start, cos, sin,
            k_cache, v_cache, slot_map=None, with_logits=True, last_pos=None,
-           table=None):
+           table=None, inject=None, full_window=False):
     """Forward a window of S tokens per slot starting at cache offset
     `start` [B] — the speculative-decoding verification pass (reference knob:
     DraftModel/NDraft, /root/reference/backend/backend.proto:218,150) and the
@@ -576,6 +589,11 @@ def extend(params, cfg: LlamaConfig, tokens, start, cos, sin,
     rows = jnp.arange(b) if slot_map is None else slot_map
     positions = start[:, None] + jnp.arange(s)[None, :]
     x = params["embed"].astype(cfg.jdtype)[tokens]
+    if inject is not None:
+        # multimodal chunk: image-feature rows replace token embeddings
+        # (see prefill's inject)
+        extra, is_embed = inject
+        x = jnp.where(is_embed[..., None], extra.astype(x.dtype), x)
 
     def layer(x, xs):
         lp, kc, vc = xs
@@ -583,12 +601,13 @@ def extend(params, cfg: LlamaConfig, tokens, start, cos, sin,
         q, k, v = _qkv(h, lp, cfg)
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
-        # paged unique=False: a chunk window's padded tail positions can
-        # resolve to the same TRASH offsets with different values (e.g.
-        # positions p and p+128 past the slot's allocation) — a genuine
-        # collision, so the uniqueness assertion would be a lie here
+        # paged uniqueness: a window whose positions all sit inside the
+        # slot's allocation (mid prefill chunks, spec verify — callers pass
+        # full_window=True) never collides; a FINAL chunk's padded tail
+        # resolves to shared TRASH offsets with different values — a
+        # genuine collision, so the assertion would be a lie there
         kc, vc = _cache_write(kc, vc, k, v, rows, positions, table,
-                              unique=table is None)
+                              unique=table is None or full_window)
         if table is not None:
             from localai_tpu.ops.paged import paged_view
 

@@ -1,0 +1,147 @@
+"""LLaVA-style vision-language chat — images in /v1/chat/completions.
+
+Reference parity: LocalAI's multimodal chat rides llama.cpp's mmproj path
+(/root/reference/backend/cpp/llama-cpp/grpc-server.cpp:285-289) and the
+vLLM/mlx-vlm backends' image inputs
+(/root/reference/backend/python/vllm/backend.py:232-252); the proto carries
+images as base64 strings (PredictOptions.images,
+/root/reference/backend/backend.proto:131). The TPU shape of the same idea:
+
+  CLIP ViT tower (models/clip_vit.py, one lax.scan block)
+    → hidden_states[vision_feature_layer], CLS dropped
+    → 2-layer gelu projector into the text hidden size
+    → spliced into the prompt as injected embeddings; the engine's
+      admission/extend programs take an (extra, is_embed) inject pair so
+      image features flow through the SAME continuous-batching slots as
+      text tokens (engine/engine.py) — no separate vision serving path.
+
+Supports both HF LLaVA save layouts: the classic
+`language_model.model.* / vision_tower.* / multi_modal_projector.*` and the
+4.52+ `model.language_model.* / model.vision_tower.* /
+model.multi_modal_projector.* / lm_head.*`.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from localai_tpu.models.clip_vit import (
+    ClipVisionConfig, load_vision_params, preprocess_image, vision_forward,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class LlavaMeta:
+    image_token_index: int
+    vision_feature_layer: int = -2
+    select_strategy: str = "default"   # "default" drops CLS, "full" keeps
+
+
+def is_llava(model_dir: str) -> bool:
+    path = os.path.join(model_dir, "config.json")
+    if not os.path.exists(path):
+        return False
+    with open(path) as f:
+        hf = json.load(f)
+    arch = (hf.get("architectures") or [""])[0]
+    return hf.get("model_type") == "llava" or arch.startswith("Llava")
+
+
+def load_vision(model_dir: str, dtype: str | None = None):
+    """Load the vision side of a LLaVA checkpoint:
+    (vision_cfg, {"tower": ..., "proj_w1", "proj_b1", "proj_w2", "proj_b2"},
+    LlavaMeta)."""
+    from localai_tpu.engine.loader import _TensorReader
+
+    with open(os.path.join(model_dir, "config.json")) as f:
+        hf: dict[str, Any] = json.load(f)
+    vcfg = ClipVisionConfig.from_hf(hf.get("vision_config") or {},
+                                    dtype=dtype or "float32")
+    meta = LlavaMeta(
+        image_token_index=hf.get("image_token_index", 32000),
+        vision_feature_layer=hf.get("vision_feature_layer", -2),
+        select_strategy=hf.get("vision_feature_select_strategy", "default"),
+    )
+    r = _TensorReader(model_dir)
+    try:
+        tower_prefix = next(
+            p for p in ("vision_tower.", "model.vision_tower.")
+            if p + "vision_model.pre_layrnorm.weight" in r)
+        proj_prefix = next(
+            p for p in ("multi_modal_projector.", "model.multi_modal_projector.")
+            if p + "linear_1.weight" in r)
+        tower = load_vision_params(r, vcfg, prefix=tower_prefix)
+        jdt = vcfg.jdtype
+        params = {
+            "tower": tower,
+            "proj_w1": jnp.asarray(
+                np.asarray(r.get(proj_prefix + "linear_1.weight"),
+                           np.float32).T, jdt),
+            "proj_b1": jnp.asarray(
+                np.asarray(r.get(proj_prefix + "linear_1.bias"), np.float32),
+                jdt),
+            "proj_w2": jnp.asarray(
+                np.asarray(r.get(proj_prefix + "linear_2.weight"),
+                           np.float32).T, jdt),
+            "proj_b2": jnp.asarray(
+                np.asarray(r.get(proj_prefix + "linear_2.bias"), np.float32),
+                jdt),
+        }
+    finally:
+        r.close()
+    return vcfg, params, meta
+
+
+def encode_images(params, vcfg: ClipVisionConfig, meta: LlavaMeta,
+                  pixel_values) -> jax.Array:
+    """pixel_values [N, 3, S, S] → projected image features [N, n_tok, H_text]
+    (n_tok = n_patches for the CLS-dropping "default" strategy)."""
+    feats = vision_forward(params["tower"], vcfg, pixel_values,
+                           feature_layer=meta.vision_feature_layer)
+    if meta.select_strategy != "full":
+        feats = feats[:, 1:]                                   # drop CLS
+    h = feats @ params["proj_w1"] + params["proj_b1"]
+    h = jax.nn.gelu(h, approximate=False)
+    return h @ params["proj_w2"] + params["proj_b2"]
+
+
+def expand_image_tokens(prompt_ids: list[int], n_images: int, n_tok: int,
+                        image_token: int) -> tuple[list[int], np.ndarray]:
+    """HF LlavaProcessor's expansion: each single image token in the prompt
+    becomes n_tok copies. Returns (expanded_ids, positions [n_images*n_tok]
+    of the expanded image slots, in image order)."""
+    occurrences = [i for i, t in enumerate(prompt_ids) if t == image_token]
+    if len(occurrences) != n_images:
+        raise ValueError(
+            f"prompt has {len(occurrences)} image placeholder(s) but "
+            f"{n_images} image(s) were attached")
+    out: list[int] = []
+    positions: list[int] = []
+    for i, t in enumerate(prompt_ids):
+        if t == image_token:
+            positions.extend(range(len(out), len(out) + n_tok))
+            out.extend([image_token] * n_tok)
+        else:
+            out.append(t)
+    return out, np.asarray(positions, np.int64)
+
+
+def decode_image_b64(data: str) -> bytes:
+    """Proto images entries: raw base64, or a data: URL."""
+    import base64
+
+    if data.startswith("data:"):
+        data = data.split(",", 1)[1]
+    return base64.b64decode(data)
+
+
+__all__ = [
+    "LlavaMeta", "is_llava", "load_vision", "encode_images",
+    "expand_image_tokens", "decode_image_b64", "preprocess_image",
+]

@@ -47,6 +47,39 @@ _SAMPLING_FIELDS = (
 )
 
 
+_IMAGE_FETCH_LIMIT = 16 << 20   # 16 MiB of image bytes per URL
+
+
+def _fetch_image(url: str) -> str:
+    """Fetch a remote image_url → base64, with the two server-side hazards
+    closed: a size cap (the body is b64-expanded into the request pipeline)
+    and an SSRF guard (no loopback/link-local/private targets — a chat
+    request must not become a probe of the server's network)."""
+    import base64
+    import ipaddress
+    import socket
+    import urllib.parse
+    import urllib.request
+
+    host = urllib.parse.urlparse(url).hostname or ""
+    try:
+        infos = socket.getaddrinfo(host, None)
+    except OSError as e:
+        raise ValueError(f"cannot resolve image host {host!r}: {e}")
+    for info in infos:
+        ip = ipaddress.ip_address(info[4][0])
+        if (ip.is_private or ip.is_loopback or ip.is_link_local
+                or ip.is_reserved or ip.is_multicast):
+            raise ValueError(f"image host {host!r} resolves to a "
+                             f"non-public address")
+    with urllib.request.urlopen(url, timeout=30) as r:
+        data = r.read(_IMAGE_FETCH_LIMIT + 1)
+    if len(data) > _IMAGE_FETCH_LIMIT:
+        raise ValueError(f"image at {host!r} exceeds "
+                         f"{_IMAGE_FETCH_LIMIT >> 20} MiB")
+    return base64.b64encode(data).decode()
+
+
 class API:
     def __init__(self, app_config: AppConfig, configs: ModelConfigLoader,
                  manager: ModelManager):
@@ -298,6 +331,38 @@ class API:
     async def _models(self, request):
         return web.json_response(schema.models_list(self.configs.names()))
 
+    @staticmethod
+    def _extract_images(messages):
+        """OpenAI vision content parts → (flattened messages, images list).
+
+        image_url parts become an <image> marker in the text (the LLaVA
+        placeholder the backend expands, models/llava.py) and their payload
+        joins the proto `images` list (reference: base64 images through
+        PredictOptions.images, backend.proto:131; content-part handling in
+        core/http/endpoints/openai chat)."""
+        images, out = [], []
+        for m in messages:
+            c = m.get("content")
+            if not isinstance(c, list):
+                out.append(m)
+                continue
+            parts = []
+            for part in c:
+                t = part.get("type")
+                if t in ("image_url", "input_image"):
+                    url = part.get("image_url")
+                    if isinstance(url, dict):
+                        url = url.get("url", "")
+                    url = url or part.get("url", "")
+                    if url.startswith("http://") or url.startswith("https://"):
+                        url = _fetch_image(url)
+                    images.append(url)
+                    parts.append("<image>")
+                elif t in ("text", "input_text"):
+                    parts.append(part.get("text", ""))
+            out.append(dict(m, content="\n".join(p for p in parts if p)))
+        return out, images
+
     async def _chat(self, request):
         body = await request.json()
         cfg = self._resolve(body)
@@ -306,8 +371,17 @@ class API:
             raise web.HTTPBadRequest(
                 text=json.dumps(schema.error_body("messages required")),
                 content_type="application/json")
+        try:
+            messages, images = await asyncio.to_thread(
+                self._extract_images, messages)
+        except Exception as e:
+            raise web.HTTPBadRequest(
+                text=json.dumps(schema.error_body(f"bad image: {e}")),
+                content_type="application/json")
         handle = await self._handle(cfg)
         opts = self._merged_options(cfg, body)
+        if images:
+            opts["images"] = images
         if cfg.template.use_tokenizer_template or not cfg.template.chat:
             opts["messages_json"] = json.dumps(messages)
             opts["use_tokenizer_template"] = True

@@ -1,0 +1,237 @@
+"""LLaVA multimodal chat path: CLIP tower parity, embedding injection, and
+end-to-end greedy parity with HF LlavaForConditionalGeneration."""
+import os
+
+import numpy as np
+import pytest
+import torch
+
+from localai_tpu.engine import Engine, EngineConfig, GenRequest
+from localai_tpu.engine.loader import load_config, load_params, load_tokenizer
+from localai_tpu.ops.sampling import SamplingParams
+
+from fixtures import tiny_checkpoint
+
+IMG_TOK = 100
+
+
+@pytest.fixture(scope="session")
+def llava_ckpt(tmp_path_factory):
+    from transformers import (
+        CLIPVisionConfig, LlamaConfig as HFLlama, LlavaConfig,
+        LlavaForConditionalGeneration,
+    )
+
+    vc = CLIPVisionConfig(
+        hidden_size=32, intermediate_size=64, num_hidden_layers=3,
+        num_attention_heads=4, image_size=28, patch_size=14,
+        projection_dim=32)
+    tc = HFLlama(
+        vocab_size=128, hidden_size=48, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128)
+    cfg = LlavaConfig(
+        vision_config=vc, text_config=tc, image_token_index=IMG_TOK,
+        vision_feature_layer=-2, vision_feature_select_strategy="default")
+    torch.manual_seed(0)
+    m = LlavaForConditionalGeneration(cfg).eval()
+    d = str(tmp_path_factory.mktemp("llava"))
+    m.save_pretrained(d, safe_serialization=True)
+    # backend LoadModel needs a tokenizer; the gRPC test drives prompt_ids,
+    # so any tokenizer file works — borrow the tiny fixture's
+    import shutil
+
+    src = tiny_checkpoint(tmp_path_factory)
+    for f in ("tokenizer.json", "tokenizer_config.json"):
+        shutil.copy(os.path.join(src, f), os.path.join(d, f))
+    return d
+
+
+def _hf(llava_ckpt):
+    from transformers import LlavaForConditionalGeneration
+
+    return LlavaForConditionalGeneration.from_pretrained(
+        llava_ckpt, torch_dtype=torch.float32).eval()
+
+
+def test_vision_tower_projector_parity(llava_ckpt):
+    """encode_images == HF get_image_features on the same checkpoint."""
+    from localai_tpu.models.llava import encode_images, load_vision
+
+    vcfg, vparams, meta = load_vision(llava_ckpt)
+    px = np.random.default_rng(0).standard_normal((2, 3, 28, 28)).astype(
+        np.float32)
+    ours = np.asarray(encode_images(vparams, vcfg, meta, px))
+    m = _hf(llava_ckpt)
+    with torch.no_grad():
+        ref = m.get_image_features(pixel_values=torch.tensor(px))
+    ref = np.stack([r.numpy() for r in ref])
+    np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_expand_image_tokens():
+    from localai_tpu.models.llava import expand_image_tokens
+
+    ids, pos = expand_image_tokens([1, IMG_TOK, 2, IMG_TOK, 3], 2, 4,
+                                   IMG_TOK)
+    assert ids == [1] + [IMG_TOK] * 4 + [2] + [IMG_TOK] * 4 + [3]
+    assert pos.tolist() == [1, 2, 3, 4, 6, 7, 8, 9]
+    with pytest.raises(ValueError, match="placeholder"):
+        expand_image_tokens([1, 2], 1, 4, IMG_TOK)
+
+
+def test_inject_identity_matches_token_prompt(tmp_path_factory):
+    """Injecting embed-table rows at prompt positions must reproduce the pure
+    token request bit-for-tolerance — the engine-side invariant the image
+    path relies on (image features are just rows the embed table never
+    had)."""
+    ckpt = tiny_checkpoint(tmp_path_factory)
+    cfg = load_config(ckpt, dtype="float32")
+    params = load_params(ckpt, cfg)
+    tok = load_tokenizer(ckpt)
+    embed = np.asarray(params["embed"], np.float32)
+
+    prompt = tok.encode("the quick brown fox jumps over")
+    sub = prompt[2:5]
+
+    def run(mm):
+        eng = Engine(cfg, params, tok, EngineConfig(
+            max_slots=2, max_context=128, prefill_buckets=(32,)))
+        req = GenRequest(list(prompt), SamplingParams(temperature=0.0),
+                         max_tokens=8, ignore_eos=True)
+        if mm:
+            req.mm_embeds = embed[sub]
+            req.mm_positions = np.arange(2, 5)
+        return [o.token_id for o in eng.generate(req)]
+
+    assert run(False) == run(True)
+
+
+def test_inject_identity_chunked_prefill(tmp_path_factory):
+    """Same invariant through the chunked-extend path (prompt > bucket)."""
+    ckpt = tiny_checkpoint(tmp_path_factory)
+    cfg = load_config(ckpt, dtype="float32")
+    params = load_params(ckpt, cfg)
+    tok = load_tokenizer(ckpt)
+    embed = np.asarray(params["embed"], np.float32)
+
+    prompt = (tok.encode("pack my box with five dozen liquor jugs") * 4)[:40]
+    positions = np.asarray([3, 14, 15, 16, 30], np.int64)
+
+    def run(mm):
+        eng = Engine(cfg, params, tok, EngineConfig(
+            max_slots=2, max_context=128, prefill_buckets=(16,),
+            prefill_chunk=16))
+        req = GenRequest(list(prompt), SamplingParams(temperature=0.0),
+                         max_tokens=6, ignore_eos=True)
+        if mm:
+            req.mm_embeds = embed[[prompt[i] for i in positions]]
+            req.mm_positions = positions
+        return [o.token_id for o in eng.generate(req)]
+
+    assert run(False) == run(True)
+
+
+def test_images_through_grpc_backend(llava_ckpt):
+    """Process-boundary path: ModelOptions(model=llava dir) loads the vision
+    tower; PredictOptions.images (base64 PNG) + a placeholder prompt stream
+    real tokens back — the reference's mmproj/vLLM-multimodal serving shape
+    (PredictOptions.images, backend.proto:131)."""
+    import base64
+    import io
+
+    from PIL import Image
+
+    from localai_tpu.backend.client import BackendClient
+    from localai_tpu.backend.server import serve
+
+    server, servicer, port = serve("127.0.0.1:0", "llm")
+    client = BackendClient(f"127.0.0.1:{port}")
+    try:
+        assert client.wait_ready(attempts=20, sleep=0.1)
+        r = client.load_model(model=llava_ckpt, dtype="float32", parallel=2,
+                              context_size=128, prefill_buckets=[16, 32])
+        assert r.success, r.message
+        buf = io.BytesIO()
+        Image.new("RGB", (40, 30), (200, 40, 40)).save(buf, format="PNG")
+        b64 = base64.b64encode(buf.getvalue()).decode()
+        reply = client.predict(prompt_ids=[1, 5, IMG_TOK, 9], tokens=6,
+                               temperature=0.0, ignore_eos=True,
+                               images=[b64])
+        assert reply.tokens == 6 and len(reply.token_ids) == 6
+        # same request, no image → the placeholder stays one token and the
+        # injected features are absent, so the continuation must differ
+        # (with these random weights); mainly: both paths serve correctly
+        reply2 = client.predict(prompt_ids=[1, 5, IMG_TOK, 9], tokens=6,
+                                temperature=0.0, ignore_eos=True)
+        assert len(reply2.token_ids) == 6
+        # image on a vision-less model errors cleanly (INVALID_ARGUMENT)
+    finally:
+        client.close()
+        servicer.shutdown()
+        server.stop(grace=1)
+
+
+def test_http_image_content_extraction():
+    """OpenAI vision content parts → flattened text + images list
+    (server/http.py _extract_images; reference: content-part handling in
+    core/http/endpoints/openai chat + utils base64)."""
+    from localai_tpu.server.http import API
+
+    msgs = [
+        {"role": "system", "content": "be brief"},
+        {"role": "user", "content": [
+            {"type": "text", "text": "what is this?"},
+            {"type": "image_url",
+             "image_url": {"url": "data:image/png;base64,QUJD"}},
+        ]},
+    ]
+    out, images = API._extract_images(msgs)
+    assert out[0] == msgs[0]
+    assert out[1]["content"] == "what is this?\n<image>"
+    assert images == ["data:image/png;base64,QUJD"]
+
+    # plain-string content and raw-base64 urls pass through
+    out2, images2 = API._extract_images(
+        [{"role": "user", "content": [
+            {"type": "image_url", "image_url": {"url": "QUJD"}},
+            {"type": "text", "text": "hi"}]}])
+    assert out2[0]["content"] == "<image>\nhi"
+    assert images2 == ["QUJD"]
+
+
+def test_llava_greedy_parity_with_hf(llava_ckpt, tmp_path_factory):
+    """Full path: pixels → tower → projector → injected prefill → greedy
+    decode == HF LlavaForConditionalGeneration.generate."""
+    from localai_tpu.models.llava import (
+        encode_images, expand_image_tokens, load_vision,
+    )
+
+    lcfg = load_config(llava_ckpt, dtype="float32")
+    lparams = load_params(llava_ckpt, lcfg, dtype="float32")
+    vcfg, vparams, meta = load_vision(llava_ckpt)
+
+    px = np.random.default_rng(1).standard_normal((1, 3, 28, 28)).astype(
+        np.float32)
+    feats = np.asarray(encode_images(vparams, vcfg, meta, px),
+                       np.float32)                      # [1, 4, 48]
+    prompt = [1, 5, IMG_TOK, 9, 11, 7]
+    ids, positions = expand_image_tokens(prompt, 1, feats.shape[1], IMG_TOK)
+
+    m = _hf(llava_ckpt)
+    with torch.no_grad():
+        out = m.generate(
+            input_ids=torch.tensor([ids]),
+            attention_mask=torch.ones((1, len(ids)), dtype=torch.long),
+            pixel_values=torch.tensor(px),
+            max_new_tokens=8, do_sample=False, pad_token_id=0,
+            eos_token_id=None)
+    ref = out[0].tolist()[len(ids):]
+
+    eng = Engine(lcfg, lparams, None, EngineConfig(
+        max_slots=2, max_context=128, prefill_buckets=(16, 32)))
+    req = GenRequest(ids, SamplingParams(temperature=0.0), max_tokens=8,
+                     ignore_eos=True, mm_embeds=feats[0],
+                     mm_positions=positions)
+    ours = [o.token_id for o in eng.generate(req)]
+    assert ours == ref
