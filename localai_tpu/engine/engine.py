@@ -219,8 +219,8 @@ class Engine:
         # dense [B, T] product. Host owns allocation; the device sees a
         # [B, MAXB] table per dispatch. Under a mesh the pool rides the XLA
         # gather path — block axis replicated, KV heads sharded on 'model'.
-        # Incompatible (v1) with speculative drafts, context-shift and the
-        # disk prompt cache.
+        # Incompatible (v1) with speculative drafts and the disk prompt
+        # cache; context-shift runs block-granular (cache_shift_paged).
         self._paged = self.ec.kv_pages > 0
         if self._paged:
             if draft is not None:
@@ -461,12 +461,38 @@ class Engine:
         self._extend_final_fn = jax.jit(_extend_final,
                                         donate_argnums=(3, 4, 5, 6, 7))
         # context shift: keep/discard are static → one compiled program
-        self._shift_discard = max(
-            1, (self.ec.max_context - self.ec.shift_keep) // 2)
-        self._shift_fn = jax.jit(
-            partial(cache_shift, cfg, keep=self.ec.shift_keep,
-                    discard=self._shift_discard),
-            donate_argnums=(0, 1, 2))
+        if self._paged:
+            # block-granular (models/llama.py cache_shift_paged): keep the
+            # sink block(s), drop a half-context worth of whole blocks; the
+            # slide itself is a host-side table permutation
+            from localai_tpu.ops.paged import BLOCK
+
+            from localai_tpu.models.llama import cache_shift_paged
+
+            self._shift_keepb = max(1, -(-self.ec.shift_keep // BLOCK))
+            self._shift_discb = max(1, (self._maxb - self._shift_keepb) // 2)
+            self._shift_discard = self._shift_discb * BLOCK
+            # a shift must leave at least one tail block to slide: tiny
+            # contexts (maxb <= keepb+discb) cannot evict block-granularly —
+            # submit() rejects context_shift there instead of driving
+            # lengths negative
+            self._shift_ok = self._maxb > (self._shift_keepb
+                                           + self._shift_discb)
+
+            def _shift_paged(kc, lengths, row_table, slot):
+                kc = cache_shift_paged(
+                    cfg, kc, row_table, keep_blocks=self._shift_keepb,
+                    discard_blocks=self._shift_discb)
+                return kc, lengths.at[slot].add(-self._shift_discard)
+
+            self._shift_fn = jax.jit(_shift_paged, donate_argnums=(0, 1))
+        else:
+            self._shift_discard = max(
+                1, (self.ec.max_context - self.ec.shift_keep) // 2)
+            self._shift_fn = jax.jit(
+                partial(cache_shift, cfg, keep=self.ec.shift_keep,
+                        discard=self._shift_discard),
+                donate_argnums=(0, 1, 2))
 
         if self._draft is not None:
             from localai_tpu.engine.spec import (
@@ -682,8 +708,25 @@ class Engine:
     def _dev_shift(self, idx):
         self._bcast("shift", idx=idx)
         with activate_mesh(self.mesh):
-            self._kc, self._vc, self._lengths = self._shift_fn(
-                self._kc, self._vc, self._lengths, jnp.int32(idx))
+            if self._paged:
+                # rotate K's tail blocks in place, then permute the table
+                # row host-side: sink blocks stay, discarded blocks
+                # re-append as fresh tail capacity (reservation unchanged)
+                self._kc, self._lengths = self._shift_fn(
+                    self._kc, self._lengths,
+                    jnp.asarray(self._table[idx]), jnp.int32(idx))
+                blocks = self._slot_blocks[idx]
+                kb, db = self._shift_keepb, self._shift_discb
+                if len(blocks) > kb + db:   # shift only fires at the cap,
+                    # where the reservation spans the full context — the
+                    # guard covers degenerate tiny-context configs
+                    newb = (blocks[:kb] + blocks[kb + db:]
+                            + blocks[kb:kb + db])
+                    self._slot_blocks[idx] = newb
+                    self._table[idx, :len(newb)] = newb
+            else:
+                self._kc, self._vc, self._lengths = self._shift_fn(
+                    self._kc, self._vc, self._lengths, jnp.int32(idx))
 
     def _dev_draft_ingest(self, buf, pos, idx):
         self._bcast("draft_ingest", buf=buf, pos=pos, idx=idx)
@@ -813,11 +856,11 @@ class Engine:
             raise ValueError(
                 "context_shift is not supported with a draft model "
                 "(the draft cache would need shifting too)")
-        if req.context_shift and self._paged:
+        if req.context_shift and self._paged and not self._shift_ok:
             raise ValueError(
-                "context_shift is not supported with paged KV (cache_shift "
-                "rewrites dense per-slot regions); use a dense cache or a "
-                "larger max_context")
+                "context_shift with paged KV needs max_context spanning "
+                "more than keep+discard blocks (128-token granularity); "
+                "raise max_context or use a dense cache")
         if self._paged and self._blocks_for(req) > self.ec.kv_pages - 1:
             raise ValueError(
                 f"request needs {self._blocks_for(req)} KV blocks "

@@ -685,6 +685,50 @@ def cache_shift(cfg: LlamaConfig, k_cache, v_cache, lengths, slot, *,
     return k_cache, v_cache, lengths
 
 
+def cache_shift_paged(cfg: LlamaConfig, k_pool, row_table, *,
+                      keep_blocks: int, discard_blocks: int):
+    """Block-granular context shift for ONE paged slot (reference ctx_shift
+    against a unified cache, grpc-server.cpp:311; dense analog: cache_shift).
+
+    With paged storage the SLIDE is free — the host permutes the slot's
+    table row (keep the first `keep_blocks` sink blocks, drop the next
+    `discard_blocks`, tail moves left; freed blocks re-append as fresh tail
+    capacity). The only physical work is K's RoPE correction: every kept
+    tail block re-rotates by -discard_blocks*BLOCK positions, IN PLACE in
+    the pool. V blocks never move or change.
+
+    row_table [MAXB] i32 is the PRE-permutation map; tail blocks (virtual
+    index >= keep_blocks+discard_blocks, physical != 0) are rotated;
+    everything else scatters to the trash block (unique=False — those rows
+    collide there by design). Returns the updated k_pool."""
+    from localai_tpu.ops.paged import BLOCK
+    from localai_tpu.ops.rope import rope_freqs
+
+    inv_freq, _ = rope_freqs(cfg.rope)
+    ang = (discard_blocks * BLOCK) * inv_freq
+    c, s = jnp.cos(ang), jnp.sin(ang)
+
+    # only the tail blocks move — gather/rotate/scatter just those
+    # (keep_blocks + discard_blocks is static under jit, so this is a
+    # plain slice, not a dynamic gather)
+    tail = row_table[keep_blocks + discard_blocks:]
+    quant = isinstance(k_pool, QuantKV)
+    kb = k_pool[:, tail]                         # [L, TAIL, KVH, BS, D]
+    kf = dequant(kb, jnp.float32) if quant else kb.astype(jnp.float32)
+    x1, x2 = jnp.split(kf, 2, axis=-1)
+    rot = jnp.concatenate([x1 * c + x2 * s, x2 * c - x1 * s], axis=-1)
+
+    target = jnp.where(tail != 0, tail, 0)       # unallocated entries → trash
+    if quant:
+        rq = requantize(kb, rot)
+        k_pool = QuantKV(
+            k_pool.q.at[:, target].set(rq.q, unique_indices=False),
+            k_pool.s.at[:, target].set(rq.s, unique_indices=False))
+        return k_pool
+    return k_pool.at[:, target].set(rot.astype(k_pool.dtype),
+                                    unique_indices=False)
+
+
 def forward_train(params, cfg: LlamaConfig, tokens):
     """Full-sequence causal forward → logits [B, S, V] (training / eval path)."""
     x = hidden_states(params, cfg, tokens)

@@ -31,7 +31,8 @@ CHAT_TEMPLATE = (
 def build_tiny_checkpoint(dirpath: str, *, vocab_size: int = 384,
                           hidden: int = 64, layers: int = 2, heads: int = 4,
                           kv_heads: int = 2, inter: int = 128,
-                          tie: bool = False, seed: int = 0) -> str:
+                          tie: bool = False, seed: int = 0,
+                          max_position: int = 256) -> str:
     """Create a tiny HF Llama checkpoint + tokenizer at `dirpath`."""
     import torch
     from tokenizers import Tokenizer, models, pre_tokenizers, decoders, trainers
@@ -62,7 +63,7 @@ def build_tiny_checkpoint(dirpath: str, *, vocab_size: int = 384,
     cfg = LlamaConfig(
         vocab_size=real_vocab, hidden_size=hidden, intermediate_size=inter,
         num_hidden_layers=layers, num_attention_heads=heads,
-        num_key_value_heads=kv_heads, max_position_embeddings=256,
+        num_key_value_heads=kv_heads, max_position_embeddings=max_position,
         rms_norm_eps=1e-5, rope_theta=10000.0, tie_word_embeddings=tie,
         bos_token_id=0, eos_token_id=1,
     )

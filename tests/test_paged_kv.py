@@ -204,3 +204,117 @@ def test_paged_under_mesh_matches_dense(loaded):
     assert set(ref) == set(got) == {0, 1, 2}
     for i in ref:
         assert got[i] == ref[i], f"request {i} diverged under mesh"
+
+
+def test_paged_context_shift_rotation_unit():
+    """cache_shift_paged mechanics: a K row at virtual position p in a tail
+    block must, after the shift, equal the raw vector re-roped at
+    p - discard_blocks*128; sink blocks stay untouched; non-slot pool blocks
+    stay untouched."""
+    import jax
+    import jax.numpy as jnp
+
+    from localai_tpu.models.llama import LlamaConfig, cache_shift_paged
+    from localai_tpu.ops.paged import BLOCK, init_paged
+    from localai_tpu.ops.rope import apply_rope, rope_table
+
+    cfg = LlamaConfig(vocab_size=64, hidden_size=16, intermediate_size=32,
+                      num_layers=2, num_heads=2, num_kv_heads=2, head_dim=8,
+                      max_position=512, dtype="float32")
+    L, KVH, D, MAXB = 2, 2, 8, 4
+    kb_keep, db = 1, 1
+    T = MAXB * BLOCK
+    cos, sin = rope_table(cfg.rope, T)
+
+    # pool with 6 physical blocks; slot uses physicals [1, 3, 4, 2]
+    kpool, _ = init_paged(L, 6, KVH, D, dtype=jnp.float32)
+    table = np.asarray([1, 3, 4, 2], np.int32)
+    raw = np.asarray(jax.random.normal(jax.random.PRNGKey(0),
+                                       (L, KVH, T, D)), np.float32)
+    roped = apply_rope(
+        jnp.asarray(raw).transpose(0, 2, 1, 3).reshape(L, T, KVH, D),
+        cos, sin, jnp.arange(T)[None, :].repeat(L, 0),
+    ).transpose(0, 2, 1, 3)                                  # [L, KVH, T, D]
+    # lay the roped rows into the pool through the table
+    kp = np.zeros((L, 6, KVH, BLOCK, D), np.float32)
+    for vb in range(MAXB):
+        kp[:, table[vb]] = np.asarray(
+            roped[:, :, vb * BLOCK:(vb + 1) * BLOCK]).transpose(0, 1, 2, 3)
+    sentinel = np.random.default_rng(0).standard_normal(
+        (L, KVH, BLOCK, D)).astype(np.float32)
+    kp[:, 5] = sentinel                                       # foreign block
+
+    out = np.asarray(cache_shift_paged(
+        cfg, jnp.asarray(kp), jnp.asarray(table),
+        keep_blocks=kb_keep, discard_blocks=db))
+
+    # sink block (virtual 0 -> physical 1) untouched
+    np.testing.assert_allclose(out[:, 1], kp[:, 1], rtol=1e-6)
+    # foreign physical block untouched
+    np.testing.assert_allclose(out[:, 5], sentinel, rtol=1e-6)
+    # tail blocks re-roped at position - db*BLOCK
+    expect = apply_rope(
+        jnp.asarray(raw).transpose(0, 2, 1, 3).reshape(L, T, KVH, D),
+        cos, sin,
+        (jnp.arange(T) - db * BLOCK)[None, :].repeat(L, 0) % T,
+    ).transpose(0, 2, 1, 3)
+    for vb in range(kb_keep + db, MAXB):
+        np.testing.assert_allclose(
+            out[:, table[vb]],
+            np.asarray(expect[:, :, vb * BLOCK:(vb + 1) * BLOCK]),
+            rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("cache_type", ["", "int8"])
+def test_paged_context_shift_generation_crosses_limit(tmp_path_factory, cache_type):
+    """A context_shift request on a PAGED engine sails past the context cap
+    (block-granular eviction) while a plain request dies at it — the paged
+    twin of test_engine.test_context_shift_generation_crosses_limit."""
+    ckpt = tiny_checkpoint(tmp_path_factory, max_position=512)
+    cfg = load_config(ckpt, dtype="float32")
+    params = load_params(ckpt, cfg)
+    tok = Tokenizer.from_dir(ckpt)
+    ctx = 512                      # 4 blocks: keepb=1, discb=1 → the shift
+    #                                permutes the table and rotates 2 tail
+    #                                blocks (the REAL path, not the no-tail
+    #                                degenerate)
+    prompt = tok.encode("the quick brown fox jumps over the lazy dog")
+    n = len(prompt)
+
+    def run(shift):
+        eng = Engine(cfg, params, tok, EngineConfig(
+            max_slots=2, max_context=ctx, prefill_buckets=(64,),
+            prefill_chunk=64, kv_pages=12, cache_type=cache_type))
+        req = GenRequest(list(prompt), SamplingParams(temperature=0.0),
+                         max_tokens=2 * ctx, ignore_eos=True,
+                         context_shift=shift)
+        _, out = eng.submit(req)
+        for _ in range(6000):
+            if not eng.step():
+                break
+        outs = []
+        while not out.empty():
+            outs.append(out.get())
+        return outs
+
+    plain = run(False)
+    assert plain[-1].finish_reason == "length"
+    assert plain[-1].generated_tokens <= ctx - n
+
+    shifted = run(True)
+    assert shifted[-1].finish_reason == "length"
+    assert shifted[-1].generated_tokens == 2 * ctx
+
+
+def test_paged_context_shift_rejected_on_tiny_context(loaded):
+    """maxb <= keep+discard blocks cannot evict block-granularly — submit
+    rejects instead of corrupting lengths."""
+    cfg, params, tok = loaded
+    eng = Engine(cfg, params, tok, EngineConfig(
+        max_slots=2, max_context=128, prefill_buckets=(64,),
+        prefill_chunk=64, kv_pages=6))
+    with pytest.raises(ValueError, match="context_shift with paged"):
+        eng.submit(GenRequest(tok.encode("hello"),
+                              SamplingParams(temperature=0.0),
+                              max_tokens=400, ignore_eos=True,
+                              context_shift=True))

@@ -133,6 +133,35 @@ def test_engine_on_mesh_matches_unmeshed():
     assert run(None) == run(mesh)
 
 
+def test_engine_multimodal_inject_on_mesh():
+    """Multimodal embedding injection under a TP/DP mesh: injecting
+    embed-table rows reproduces the pure-token request on the SAME mesh —
+    the sharded flagship config serves images too."""
+    import numpy as np
+
+    from localai_tpu.engine import Engine, EngineConfig, GenRequest
+    from localai_tpu.ops.sampling import SamplingParams
+
+    ps = init_params(CFG, jax.random.PRNGKey(3))
+    mesh = build_mesh(MeshConfig(data=2, model=4))
+    prompt = [5, 9, 2, 7, 11, 3]
+    embed = np.asarray(ps["embed"], np.float32)
+
+    def run(mm):
+        eng = Engine(CFG, shard_params(ps, param_specs(CFG), mesh), None,
+                     EngineConfig(max_slots=2, max_context=64,
+                                  prefill_buckets=(16,), mesh=mesh))
+        req = GenRequest(prompt_ids=list(prompt),
+                         params=SamplingParams(temperature=0.0),
+                         max_tokens=8, ignore_eos=True)
+        if mm:
+            req.mm_embeds = embed[[9, 2, 7]]
+            req.mm_positions = np.arange(1, 4)
+        return [o.token_id for o in eng.generate(req)]
+
+    assert run(False) == run(True)
+
+
 def test_engine_seq_parallel_matches_unmeshed():
     """Ring-attention serving integration: an engine on a ('data','model',
     'seq') mesh (sequence-parallel prefill over the ppermute ring) must
