@@ -219,12 +219,11 @@ class Engine:
         # dense [B, T] product. Host owns allocation; the device sees a
         # [B, MAXB] table per dispatch. Under a mesh the pool rides the XLA
         # gather path — block axis replicated, KV heads sharded on 'model'.
-        # Incompatible (v1) with speculative drafts and the disk prompt
-        # cache; context-shift runs block-granular (cache_shift_paged).
+        # Incompatible (v1) with the disk prompt cache; context-shift runs
+        # block-granular (cache_shift_paged); speculative decoding pages
+        # the TARGET cache (the small draft keeps a dense one).
         self._paged = self.ec.kv_pages > 0
         if self._paged:
-            if draft is not None:
-                raise NotImplementedError("paged KV with a draft model")
             if self.ec.kv_pages < 2:
                 raise ValueError("kv_pages must be >= 2 (block 0 is trash)")
         if self._draft is not None and self._draft[0].vocab_size != V:
@@ -755,7 +754,7 @@ class Engine:
                 self.params, self._draft[1], self._cos, self._sin,
                 self._cos_d, self._sin_d, self._kc, self._vc,
                 self._kcd, self._vcd, self._sampler, self._lengths,
-                self._next_tokens, jnp.asarray(active))
+                self._next_tokens, jnp.asarray(active), self._tab())
         return tokens_out, n_out, logprobs_out, n_extra
 
     def follow(self, channel) -> None:
@@ -1611,7 +1610,8 @@ class Engine:
             self._mask_host[idx] = 0xFF
             self._grammar_slots -= 1
         if self._paged:
-            if self.ec.prompt_cache and slot.shifted == 0:
+            if (self.ec.prompt_cache and slot.shifted == 0
+                    and self._draft is None):
                 # retain ONLY the blocks holding cached rows as the warm
                 # prefix cache (reclaimable oldest-first, _take_blocks); the
                 # unused tail of the reservation returns to the pool now.

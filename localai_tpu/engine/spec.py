@@ -76,10 +76,9 @@ def build_spec_decode(cfg_t: LlamaConfig, cfg_d: LlamaConfig, gamma: int):
 
     def spec_decode(params_t, params_d, cos_t, sin_t, cos_d, sin_d,
                     kct, vct, kcd, vcd, sampler, lengths, next_tokens,
-                    active):
+                    active, table=None):
         B = next_tokens.shape[0]
         G = gamma
-        T = kct.shape[3]
         act_i = active.astype(jnp.int32)
 
         # one key split per step; all draws derive via fold_in
@@ -119,9 +118,22 @@ def build_spec_decode(cfg_t: LlamaConfig, cfg_d: LlamaConfig, gamma: int):
 
         # ---- target verify: one extend over [next_token, d_1..d_gamma]
         window = jnp.concatenate([next_tokens[:, None], d_tok], axis=1)
-        start = jnp.where(active, lengths, T - 1)
-        tlogits, kct, vct = extend(params_t, cfg_t, window, start,
-                                   cos_t, sin_t, kct, vct)       # [B,G+1,V]
+        if table is None:
+            # dense inactive redirect: start T-1 puts the first garbage row
+            # at the never-readable last position; the rest fall out of
+            # bounds and the scatter drops them
+            T = kct.shape[3]
+            start = jnp.where(active, lengths, T - 1)
+            tlogits, kct, vct = extend(params_t, cfg_t, window, start,
+                                       cos_t, sin_t, kct, vct)   # [B,G+1,V]
+        else:
+            # paged: out-of-bounds positions would CLAMP through the table
+            # gather into a real block, so inactive rows route their whole
+            # window to the trash block instead (models/llama.py extend
+            # redirect)
+            tlogits, kct, vct = extend(params_t, cfg_t, window, lengths,
+                                       cos_t, sin_t, kct, vct, table=table,
+                                       redirect=~active)         # [B,G+1,V]
         ps_t = jnp.stack(
             [sampling_probs(tlogits[:, i], sampler) for i in range(G + 1)],
             axis=1)                                              # [B,G+1,V]

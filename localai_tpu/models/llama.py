@@ -260,8 +260,13 @@ def _cache_write(kc, vc, k, v, rows, positions, table=None, unique=True,
         pb = table[rows[:, None], positions // BLOCK]      # [B, S] physical
         off = positions % BLOCK
         if redirect is not None:
+            # distinct per-(row, window-pos) trash offsets: collision-free
+            # (and so assertable-unique) as long as B*S <= BLOCK
+            s = positions.shape[1]
+            tr_off = (rows[:, None] * s
+                      + jnp.arange(s)[None, :]) % BLOCK
             pb = jnp.where(redirect[:, None], 0, pb)
-            off = jnp.where(redirect[:, None], (rows % BLOCK)[:, None], off)
+            off = jnp.where(redirect[:, None], tr_off, off)
         idx = (pb[:, None, :], jnp.arange(kvh)[None, :, None],
                off[:, None, :])
     if isinstance(kc, QuantKV):
@@ -569,7 +574,7 @@ def hidden_states(params, cfg: LlamaConfig, tokens, lengths=None):
 
 def extend(params, cfg: LlamaConfig, tokens, start, cos, sin,
            k_cache, v_cache, slot_map=None, with_logits=True, last_pos=None,
-           table=None, inject=None, full_window=False):
+           table=None, inject=None, full_window=False, redirect=None):
     """Forward a window of S tokens per slot starting at cache offset
     `start` [B] — the speculative-decoding verification pass (reference knob:
     DraftModel/NDraft, /root/reference/backend/backend.proto:218,150) and the
@@ -602,12 +607,19 @@ def extend(params, cfg: LlamaConfig, tokens, start, cos, sin,
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
         # paged uniqueness: a window whose positions all sit inside the
-        # slot's allocation (mid prefill chunks, spec verify — callers pass
+        # slot's allocation (mid prefill chunks — callers pass
         # full_window=True) never collides; a FINAL chunk's padded tail
         # resolves to shared TRASH offsets with different values — a
-        # genuine collision, so the assertion would be a lie there
-        kc, vc = _cache_write(kc, vc, k, v, rows, positions, table,
-                              unique=table is None or full_window)
+        # genuine collision, so the assertion would be a lie there. A
+        # redirect (paged spec verify: inactive rows' windows route to the
+        # trash block) gets distinct per-(row, pos) offsets, so it stays
+        # unique while B*S fits one block.
+        red_ok = redirect is None or b * s <= 128
+        kc, vc = _cache_write(
+            kc, vc, k, v, rows, positions, table,
+            unique=(table is None or full_window or redirect is not None)
+            and red_ok,
+            redirect=redirect)
         if table is not None:
             from localai_tpu.ops.paged import paged_view
 

@@ -150,3 +150,41 @@ def test_spec_under_mesh_matches_unmeshed(models, data, model):
         max_tokens=24, ignore_eos=True))]
     assert out == plain
     assert eng.metrics["draft_proposed"] > 0   # the spec path actually ran
+
+
+@pytest.mark.parametrize("cache_type", ["", "int8"])
+def test_spec_on_paged_kv_matches_dense(models, cache_type):
+    """Speculative decoding with a PAGED target cache (dense draft) must
+    reproduce the dense-cache spec engine token-for-token — greedy and
+    seeded-stochastic, multiple concurrent slots."""
+    params_t, params_d = models
+
+    def run(kv_pages):
+        eng = Engine(TARGET, params_t, None, EngineConfig(
+            max_slots=2, max_context=256, prefill_buckets=(32,), gamma=4,
+            kv_pages=kv_pages, cache_type=cache_type),
+            draft=(DRAFT, params_d))
+        eng.start()
+        reqs = [
+            GenRequest([3, 14, 15, 9, 2, 6],
+                       SamplingParams(temperature=0.0),
+                       max_tokens=20, ignore_eos=True),
+            GenRequest([5, 9, 2, 7],
+                       SamplingParams(temperature=0.9, top_k=0, seed=13),
+                       max_tokens=20, ignore_eos=True),
+        ]
+        outs = [eng.submit(r) for r in reqs]
+        res = []
+        for rid, q in outs:
+            ids = []
+            while True:
+                o = q.get(timeout=240)
+                if o.token_id >= 0:
+                    ids.append(o.token_id)
+                if o.finished:
+                    break
+            res.append(ids)
+        eng.stop()
+        return res
+
+    assert run(0) == run(8)
