@@ -160,7 +160,9 @@ class _Slot:
     shifted: int = 0                 # tokens evicted by context shifts
     disk_prefix: int = 0             # prefix length loaded from the disk
                                      # prompt cache (skip the re-save)
-    fast_ok: bool = False            # sampling fits the sort-free top-k path
+    fast_w: int | None = None        # narrowest sort-free top-k width that
+                                     # covers this slot's sampling (None =
+                                     # needs the full-sort path)
 
 
 class Engine:
@@ -498,6 +500,20 @@ class Engine:
                 build_draft_ingest, build_spec_admit_tail, build_spec_decode,
             )
 
+            if self._paged:
+                from localai_tpu.ops.paged import BLOCK
+
+                if self.ec.max_slots * (self.ec.gamma + 1) > BLOCK:
+                    import logging
+
+                    logging.getLogger("localai_tpu").warning(
+                        "paged spec verify: %d slots x (gamma+1)=%d trash "
+                        "offsets exceed one %d-token block, so the verify "
+                        "scatter cannot assert uniqueness — expect reduced "
+                        "paged throughput; lower max_slots or gamma to "
+                        "restore the in-place path",
+                        self.ec.max_slots, self.ec.gamma + 1, BLOCK)
+
             dcfg = self._draft[0]
             _spec_raw = build_spec_decode(cfg, dcfg, self.ec.gamma)
 
@@ -523,10 +539,13 @@ class Engine:
                                   static_argnames=())
         self._decode_nomask_fn = jax.jit(
             partial(_decode, mask_bits=None), donate_argnums=(3, 4, 5, 6, 7))
+        # fast_width static → one compiled variant per width (the base
+        # width plus the 8x escalation tier: one wide-top_k tenant no
+        # longer de-optimizes the whole batch to the full-sort path)
         self._decode_fast_fn = jax.jit(
-            partial(_decode, mask_bits=None,
-                    fast_width=self.ec.sampling_topk_width or None),
-            donate_argnums=(3, 4, 5, 6, 7))
+            partial(_decode, mask_bits=None),
+            donate_argnums=(3, 4, 5, 6, 7),
+            static_argnames=("fast_width",))
 
         def _decode_block(params, cos, sin, kc, vc, sampler, last_logits,
                           lengths, active, mask_bits=None, table=None, *,
@@ -674,7 +693,7 @@ class Engine:
             elif fast_width:
                 (tokens, logprobs, self._kc, self._vc, self._sampler,
                  self._last_logits, self._lengths) = self._decode_fast_fn(
-                    *args, table=self._tab())
+                    *args, table=self._tab(), fast_width=fast_width)
             else:
                 (tokens, logprobs, self._kc, self._vc, self._sampler,
                  self._last_logits, self._lengths) = self._decode_nomask_fn(
@@ -978,16 +997,25 @@ class Engine:
 
         W = self.ec.sampling_topk_width
         p = req.params
-        fast_ok = bool(W and not req.grammar
-                       and 0 < (p.top_k or 0) <= W
-                       and (p.typical_p is None or p.typical_p >= 1.0))
+        fast_w = None
+        if W and not req.grammar and (p.typical_p is None
+                                      or p.typical_p >= 1.0):
+            V = self.cfg.vocab_size
+            tk = min(p.top_k or 0, V)   # sampler_row clamps the row the same
+            if 0 < tk <= min(W, V):
+                fast_w = min(W, V)
+            elif 0 < tk <= min(8 * W, V):
+                # escalation tier: a wide-top_k request rides an 8x-wider
+                # (vocab-capped) sort-free window instead of dragging the
+                # whole batch onto the full [B, V] sort path
+                fast_w = min(8 * W, V)
         slot_obj = _Slot(
             request_id=rid, req=req, out=out,
             detok=self.tok.stream_decoder() if self.tok else None,
             matcher=matcher,
             start_time=time.monotonic(), prompt_len=n,
             prefilled=not chunked, row=row, counts_row=counts_row,
-            prefill_pos=lcp, disk_prefix=disk_prefix, fast_ok=fast_ok,
+            prefill_pos=lcp, disk_prefix=disk_prefix, fast_w=fast_w,
         )
         self._slots[slot] = slot_obj
         if chunked:
@@ -1173,12 +1201,16 @@ class Engine:
             return None
         entries = [(int(i), self._slots[i].request_id)
                    for i in np.where(active)[0]]
-        # sort-free sampling only when EVERY active slot's knobs fit the
-        # top-k window (and no grammar masks are live)
-        fast = (self.ec.sampling_topk_width or None) if (
-            self._grammar_slots == 0
-            and all(self._slots[i] is not None and self._slots[i].fast_ok
-                    for i, _ in entries)) else None
+        # sort-free sampling only when EVERY active slot's knobs fit SOME
+        # top-k window (and no grammar masks are live); the dispatch width
+        # is the widest any active slot needs — one wide-top_k tenant costs
+        # the batch a wider window, not the full-sort path
+        fast = None
+        if self._grammar_slots == 0:
+            ws = [self._slots[i].fast_w if self._slots[i] is not None
+                  else None for i, _ in entries]
+            if all(w is not None for w in ws):
+                fast = max(ws)
         steps = self._block_steps()
         # snapshot the dispatch-time masks: _consume compares each slot's
         # refreshed mask against what the device sampled under, to catch the
@@ -1438,6 +1470,11 @@ class Engine:
         from localai_tpu.ops.paged import blocks_needed
 
         margin = 2 * self.ec.decode_block + 1   # in-flight pipelined writes
+        if self._draft is not None:
+            # the spec-verify window writes up to gamma+1 positions past the
+            # sampled length — the reservation must cover the overshoot or
+            # the tail of the window silently lands in the trash block
+            margin = max(margin, self.ec.gamma + 1)
         tokens = min(len(req.prompt_ids) + max(req.max_tokens, 0) + margin,
                      self.ec.max_context)
         return blocks_needed(tokens)

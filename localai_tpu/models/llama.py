@@ -234,11 +234,13 @@ def _cache_write(kc, vc, k, v, rows, positions, table=None, unique=True,
     (table[slot, pos // BS], :, pos % BS) — ops/paged.py layout.
 
     redirect [B] bool (paged only): rows flagged True write to the TRASH
-    block (physical 0, ops/paged.py) at offset row%BLOCK instead of through
-    their table — the inactive-slot decode redirect. Routing by PHYSICAL
-    block keeps the garbage out of every real block (a slot's own table can
-    map its last virtual block to a RETAINED warm-prefix block), and the
-    per-row offsets keep the scatter collision-free.
+    block (physical 0, ops/paged.py) at offset (row*S + s) % BLOCK instead
+    of through their table — the inactive-slot redirect for decode (S=1)
+    and the spec-verify window (S=gamma+1). Routing by PHYSICAL block keeps
+    the garbage out of every real block (a slot's own table can map its
+    last virtual block to a RETAINED warm-prefix block); the per-(row, s)
+    offsets keep the scatter collision-free only while B*S <= BLOCK —
+    callers must drop the uniqueness assertion beyond that.
 
     unique=True asserts the scatter rows never collide: decode rows target
     distinct slots (one row per slot; redirected rows get distinct trash
@@ -613,8 +615,11 @@ def extend(params, cfg: LlamaConfig, tokens, start, cos, sin,
         # genuine collision, so the assertion would be a lie there. A
         # redirect (paged spec verify: inactive rows' windows route to the
         # trash block) gets distinct per-(row, pos) offsets, so it stays
-        # unique while B*S fits one block.
-        red_ok = redirect is None or b * s <= 128
+        # unique while B*S fits one block (beyond that the engine warns at
+        # init — engine._build_jit).
+        from localai_tpu.ops.paged import BLOCK as _PB
+
+        red_ok = redirect is None or b * s <= _PB
         kc, vc = _cache_write(
             kc, vc, k, v, rows, positions, table,
             unique=(table is None or full_window or redirect is not None)

@@ -178,6 +178,39 @@ def test_burst_admission_matches_sequential(loaded):
     assert burst == seq
 
 
+def test_wide_topk_rides_escalated_fast_path(loaded):
+    """A top_k above the base sort-free width but under 8x of it samples on
+    the escalated window — identical tokens to the full-sort path, and the
+    batch never falls back to full [B, V] sorting."""
+    cfg, params, tok = loaded
+    prompt = tok.encode("pack my box with five")
+
+    def run(width):
+        eng = Engine(cfg, params, tok, EngineConfig(
+            max_slots=2, max_context=128, prefill_buckets=(32,),
+            sampling_topk_width=width))
+        # top_k=50 > 8 (base) but <= 64 (8x tier) when width=8
+        req = GenRequest(list(prompt),
+                         SamplingParams(temperature=0.9, top_k=50, seed=21),
+                         max_tokens=10, ignore_eos=True)
+        seen = {"w": []}
+        orig = eng._dev_decode
+
+        def spy(active, mask_host=None, fast_width=None):
+            seen["w"].append(fast_width)
+            return orig(active, mask_host, fast_width)
+
+        eng._dev_decode = spy
+        toks = [o.token_id for o in eng.generate(req)]
+        return toks, seen["w"]
+
+    full_toks, full_w = run(0)        # width 0 disables the fast path
+    fast_toks, fast_w = run(8)
+    assert full_toks == fast_toks
+    assert all(w is None for w in full_w)
+    assert all(w == 64 for w in fast_w)   # escalated 8x tier, never full
+
+
 def test_stop_sequence_truncates(loaded):
     cfg, params, tok = loaded
     eng = Engine(cfg, params, tok, EngineConfig(max_slots=1, max_context=128,
