@@ -192,6 +192,36 @@ class LLMServicer(BackendServicer):
         self.cfg, self.tok = cfg, tok
         self.model_name = request.model
         self.engine.start()
+        if os.environ.get("LOCALAI_NO_PREWARM") != "1":
+            self._prewarm()
+
+    def _prewarm(self):
+        """Compile the serving hot path before LoadModel returns READY (the
+        llama.cpp server warms its graph the same way): K=1 admission, the
+        fused decode block, and the fast-sampling tail. Without this the
+        FIRST user request pays tens of seconds of XLA compiles on TPU."""
+        from localai_tpu.engine import GenRequest
+        from localai_tpu.ops.sampling import SamplingParams
+
+        try:
+            n = 3 * self.engine.ec.decode_block + 2
+            # two warm requests: the sort-free fast path (greedy/top_k) and
+            # the full-sort path (top_k=0 MUST be explicit — the dataclass
+            # default is 40, which would silently warm the fast path twice)
+            for sp in (SamplingParams(temperature=0.0, top_k=40),
+                       SamplingParams(temperature=0.8, top_p=0.9, top_k=0,
+                                      seed=1)):
+                _, q = self.engine.submit(GenRequest(
+                    prompt_ids=[1], max_tokens=n, ignore_eos=True,
+                    params=sp))
+                while not q.get(timeout=600).finished:
+                    pass
+        except Exception:
+            import logging
+
+            logging.getLogger("localai_tpu").warning(
+                "prewarm failed; first request will pay compiles",
+                exc_info=True)
 
     def _load_bert(self, request, model_dir: str):
         """Embedding-only load path for BERT-family encoders: generation RPCs

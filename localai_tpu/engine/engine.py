@@ -996,13 +996,18 @@ class Engine:
                     self._dev_draft_ingest(ids, 0, slot)
 
         W = self.ec.sampling_topk_width
-        p = req.params
+        # `p` is the normalized params from the heavy-row check above
         fast_w = None
         if W and not req.grammar and (p.typical_p is None
                                       or p.typical_p >= 1.0):
             V = self.cfg.vocab_size
             tk = min(p.top_k or 0, V)   # sampler_row clamps the row the same
-            if 0 < tk <= min(W, V):
+            if p.greedy:
+                # greedy is argmax — rank 0 of ANY top-k window is exact, so
+                # a plain temperature=0 request (the most common of all)
+                # always rides the sort-free path
+                fast_w = min(W, V)
+            elif 0 < tk <= min(W, V):
                 fast_w = min(W, V)
             elif 0 < tk <= min(8 * W, V):
                 # escalation tier: a wide-top_k request rides an 8x-wider

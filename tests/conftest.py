@@ -14,6 +14,10 @@ Two subtleties in this environment:
 """
 import os
 
+# keep backend-spawning tests fast: skip the serving prewarm request the
+# llm backend otherwise runs at LoadModel (backend/llm.py _prewarm)
+os.environ.setdefault("LOCALAI_NO_PREWARM", "1")
+
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
