@@ -205,12 +205,18 @@ class LLMServicer(BackendServicer):
 
         try:
             n = 3 * self.engine.ec.decode_block + 2
-            # two warm requests: the sort-free fast path (greedy/top_k) and
-            # the full-sort path (top_k=0 MUST be explicit — the dataclass
-            # default is 40, which would silently warm the fast path twice)
-            for sp in (SamplingParams(temperature=0.0, top_k=40),
-                       SamplingParams(temperature=0.8, top_p=0.9, top_k=0,
-                                      seed=1)):
+            # three warm requests: the sort-free fast path (greedy/top_k),
+            # its 8x escalation tier (wide top_k), and the full-sort path
+            # (top_k=0 MUST be explicit — the dataclass default is 40,
+            # which would silently warm the fast path twice)
+            W = self.engine.ec.sampling_topk_width
+            warm = [SamplingParams(temperature=0.0, top_k=40),
+                    SamplingParams(temperature=0.8, top_p=0.9, top_k=0,
+                                   seed=1)]
+            if W and 2 * W <= self.cfg.vocab_size:
+                warm.insert(1, SamplingParams(temperature=0.8, top_k=2 * W,
+                                              seed=2))
+            for sp in warm:
                 _, q = self.engine.submit(GenRequest(
                     prompt_ids=[1], max_tokens=n, ignore_eos=True,
                     params=sp))

@@ -255,6 +255,7 @@ class Engine:
         self._small_max = max(self._small_buckets)
         self._prefillq: list[int] = []   # slot indices mid-prefill, FIFO
         self._pending = None             # in-flight decode (pipeline depth 1)
+        self._inflight_steps = 0         # step count of the pending dispatch
         self._queue: "queue.Queue[tuple[int, GenRequest, queue.Queue]]" = queue.Queue()
         self._next_id = 0
         self._lock = threading.Lock()
@@ -1172,12 +1173,16 @@ class Engine:
     def _block_steps(self) -> int:
         """How many decode steps the next dispatch may fuse. 1 whenever a
         per-token host decision is live: pending admissions or chunked
-        prefills (so new requests don't wait a whole block), a slot near its
-        context limit / shift boundary, or a slot that would finish well
-        inside the block (don't burn steps past max_tokens). Grammar slots DO
-        ride blocks — sampled under their block-start mask, host-verified
-        against the PDA, rolled back at the first stale-mask miss — so one
-        constrained request no longer serializes every other tenant."""
+        prefills (so new requests don't wait a whole block) or a slot near
+        its context limit / shift boundary. A slot approaching max_tokens
+        steps the batch DOWN a power-of-two ladder (16→8→4→2→1) instead of
+        collapsing it to single steps — on a tunneled chip each dispatch
+        pays the link RTT, and the old cliff single-stepped the last
+        2*G tokens of EVERY request (a quarter of a 128-token stream).
+        Grammar slots DO ride blocks — sampled under their block-start
+        mask, host-verified against the PDA, rolled back at the first
+        stale-mask miss — so one constrained request no longer serializes
+        every other tenant."""
         G = self.ec.decode_block
         if (G <= 1 or not self.ec.pipeline or self._prefillq
                 or (self._free and not self._queue.empty())):
@@ -1185,6 +1190,7 @@ class Engine:
             # a saturated engine keeps full block fusion
             return 1
         limit = self.ec.max_context - 2 - self._ctx_reserve
+        steps = G
         for s in self._slots:
             if s is None or not s.prefilled:
                 continue
@@ -1192,9 +1198,19 @@ class Engine:
             # `generated` is stale by up to a full block when this guard runs
             if s.prompt_len + s.generated - s.shifted + 2 * G >= limit:
                 return 1
-            if s.generated + 2 * G > s.req.max_tokens:
+            # remaining tokens, discounted by the ACTUAL in-flight
+            # dispatch's staleness (not the max block size — the tail then
+            # rides 4/2-step dispatches to the end); overshooting a slot's
+            # max_tokens only wastes its lanes (emission stops at the bound
+            # and the slot is released), so the ladder trades a little tail
+            # compute for RTT
+            stale = self._inflight_steps if self._pending is not None else 0
+            rem = s.req.max_tokens - s.generated - stale
+            while steps > 1 and steps * 2 > max(rem, 1):
+                steps //= 2
+            if steps == 1:
                 return 1
-        return G
+        return steps
 
     def _dispatch(self):
         """Dispatch one decode step — or a fused block of them — for the
@@ -1221,6 +1237,7 @@ class Engine:
         # refreshed mask against what the device sampled under, to catch the
         # allowed-set GROWING mid-block (see _consume)
         gmask = self._mask_host.copy() if self._grammar_slots > 0 else None
+        self._inflight_steps = steps
         if steps > 1:
             tokens, logprobs = self._dev_decode_block(active, steps, fast,
                                                       gmask)

@@ -213,3 +213,32 @@ def test_draft_model_load_and_stream(ckpt):
         assert m["draft_accepted"] >= 0
     finally:
         s.shutdown()
+
+
+def test_load_model_prewarm_path(ckpt, monkeypatch):
+    """LoadModel's serving prewarm (backend/llm.py _prewarm) runs when not
+    disabled and leaves the engine READY with the hot programs compiled —
+    the suite otherwise disables it (conftest LOCALAI_NO_PREWARM=1), so
+    this is the one place the path executes under CI."""
+    monkeypatch.delenv("LOCALAI_NO_PREWARM", raising=False)
+    from localai_tpu.backend.client import BackendClient
+    from localai_tpu.backend.server import serve
+
+    server, servicer, port = serve("127.0.0.1:0", "llm")
+    client = BackendClient(f"127.0.0.1:{port}")
+    try:
+        assert client.wait_ready(attempts=20, sleep=0.1)
+        r = client.load_model(model=ckpt, dtype="float32", parallel=2,
+                              context_size=128, prefill_buckets=[32])
+        assert r.success, r.message
+        # prewarm generated through the engine: its dispatch counters moved
+        m = client.metrics()
+        assert m.get("decode_dispatches", 0) > 0
+        assert m.get("tokens_generated", 0) > 0
+        reply = client.predict(prompt="hello", tokens=4, temperature=0.0,
+                               ignore_eos=True)
+        assert len(reply.token_ids) == 4
+    finally:
+        client.close()
+        servicer.shutdown()
+        server.stop(grace=1)
