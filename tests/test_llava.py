@@ -107,6 +107,30 @@ def test_inject_identity_matches_token_prompt(tmp_path_factory):
     assert run(False) == run(True)
 
 
+def test_inject_identity_paged_kv(tmp_path_factory):
+    """Inject invariant on a PAGED engine: image-feature injection and the
+    block-table cache compose."""
+    ckpt = tiny_checkpoint(tmp_path_factory)
+    cfg = load_config(ckpt, dtype="float32")
+    params = load_params(ckpt, cfg)
+    tok = load_tokenizer(ckpt)
+    embed = np.asarray(params["embed"], np.float32)
+    prompt = tok.encode("the quick brown fox jumps over")
+
+    def run(mm):
+        eng = Engine(cfg, params, tok, EngineConfig(
+            max_slots=2, max_context=128, prefill_buckets=(32,),
+            kv_pages=6))
+        req = GenRequest(list(prompt), SamplingParams(temperature=0.0),
+                         max_tokens=8, ignore_eos=True)
+        if mm:
+            req.mm_embeds = embed[prompt[2:5]]
+            req.mm_positions = np.arange(2, 5)
+        return [o.token_id for o in eng.generate(req)]
+
+    assert run(False) == run(True)
+
+
 def test_inject_identity_chunked_prefill(tmp_path_factory):
     """Same invariant through the chunked-extend path (prompt > bucket)."""
     ckpt = tiny_checkpoint(tmp_path_factory)

@@ -6,9 +6,10 @@
 #   0. tunnel RTT probe                      (TTFT floor measurement)
 #   1. real-TPU kernel/engine tests
 #   2. serve bench, 16 slots                 (post batched-admission + bf16 lm_head)
-#   3. serve bench, 32 slots paged KV        (unique-scatter fix validation)
-#   -- gate: stages 2-3 must report a real TPU device, else retry --
-#   3b/3c. serve bench 32 / 48 slots DENSE int8 KV (headline-config search)
+#   3. serve bench, 32 slots DENSE int8 KV   (default-config candidate)
+#   -- gate: BOTH stages 2-3 must report a real TPU device, else retry --
+#   3b. serve bench, 32 slots paged KV       (unique-scatter fix validation)
+#   3c. serve bench, 48 slots DENSE int8 KV  (headline-config search)
 #   4. engine-mode 32 paged vs dense         (serve-vs-device split)
 #   5. attention slot sweep                  (dense vs paged kernel B=8..48)
 #   6. long-context serve                    (ctx 8192, 3968-token prompts)
@@ -38,16 +39,17 @@ while true; do
     timeout 3600 python bench.py > bench_runs/bench16b.json 2> bench_runs/bench16b.log
     log "stage 2 rc=$? ($(cat bench_runs/bench16b.json))"
 
-    log "stage 3: serve bench 32 slots, paged KV (320 blocks)"
-    timeout 3600 python bench.py --slots 32 --kv-pages 320 \
-      > bench_runs/bench32b.json 2> bench_runs/bench32b.log
-    log "stage 3 rc=$? ($(cat bench_runs/bench32b.json))"
+    log "stage 3: serve bench 32 slots DENSE int8 KV (default-config candidate)"
+    timeout 3600 python bench.py --slots 32 \
+      > bench_runs/bench32d.json 2> bench_runs/bench32d.log
+    log "stage 3 rc=$? ($(cat bench_runs/bench32d.json))"
 
-    if grep -q '"device": "TPU' bench_runs/bench16b.json bench_runs/bench32b.json; then
-      log "stage 3b: serve bench 32 slots DENSE int8 KV (fits: ~10.3 GB)"
-      timeout 3600 python bench.py --slots 32 \
-        > bench_runs/bench32d.json 2> bench_runs/bench32d.log
-      log "stage 3b rc=$? ($(cat bench_runs/bench32d.json))"
+    if grep -q '"device": "TPU' bench_runs/bench16b.json \
+        && grep -q '"device": "TPU' bench_runs/bench32d.json; then
+      log "stage 3b: serve bench 32 slots, paged KV (320 blocks)"
+      timeout 3600 python bench.py --slots 32 --kv-pages 320 \
+        > bench_runs/bench32b.json 2> bench_runs/bench32b.log
+      log "stage 3b rc=$? ($(cat bench_runs/bench32b.json))"
 
       log "stage 3c: serve bench 48 slots DENSE int8 KV (~11.4 GB)"
       timeout 3600 python bench.py --slots 48 \
