@@ -419,6 +419,9 @@ class API:
                     "token_generation_s": reply.timing_token_generation,
                 },
                 tool_calls=tool_calls)
+            schema.merge_extra_usage(resp, request,
+                                     reply.timing_prompt_processing,
+                                     reply.timing_token_generation)
             return web.json_response(resp)
         finally:
             handle.mark_idle()
@@ -442,11 +445,14 @@ class API:
 
         await send(schema.chat_chunk(rid, cfg.name, None, role=True))
         prompt_tokens = completion_tokens = 0
+        t_prompt = t_gen = 0.0
         finish = "stop"
         buffered: list[str] = []
         async for reply in self._stream_rpc(handle, opts):
             prompt_tokens = reply.prompt_tokens
             completion_tokens = reply.tokens
+            t_prompt = reply.timing_prompt_processing or t_prompt
+            t_gen = reply.timing_token_generation or t_gen
             text = reply.message.decode("utf-8", "replace")
             if text:
                 if tools_active:
@@ -471,8 +477,10 @@ class API:
         if stream_opts.get("include_usage", True):
             # default-on: LocalAI clients expect the usage tail unless the
             # OpenAI stream_options flag explicitly disables it
-            await send(schema.chat_usage_chunk(rid, cfg.name, prompt_tokens,
-                                               completion_tokens))
+            tail = schema.chat_usage_chunk(rid, cfg.name, prompt_tokens,
+                                           completion_tokens)
+            schema.merge_extra_usage(tail, request, t_prompt, t_gen)
+            await send(tail)
         await resp.write(b"data: [DONE]\n\n")
         await resp.write_eof()
         return resp
@@ -497,9 +505,13 @@ class API:
                 return await self._completion_stream(request, cfg, handle, opts)
             reply = await asyncio.to_thread(
                 lambda: handle.client.predict(**opts))
-            return web.json_response(schema.text_completion(
+            out = schema.text_completion(
                 cfg.name, reply.message.decode("utf-8", "replace"),
-                reply.finish_reason, reply.prompt_tokens, reply.tokens))
+                reply.finish_reason, reply.prompt_tokens, reply.tokens)
+            schema.merge_extra_usage(out, request,
+                                     reply.timing_prompt_processing,
+                                     reply.timing_token_generation)
+            return web.json_response(out)
         finally:
             handle.mark_idle()
 
@@ -581,7 +593,12 @@ class API:
         for f in _SAMPLING_FIELDS + ("max_tokens",):
             if f in body:
                 sub[f] = body[f]
-        resp = await self._loopback("/v1/completions", sub)
+        # forward the Extra-Usage opt-in (reference edit.go:35) — the
+        # completion leg then merges timings into the usage we relay
+        eu = request.headers.get("Extra-Usage")
+        resp = await self._loopback(
+            "/v1/completions", sub,
+            extra_headers={"Extra-Usage": eu} if eu else None)
         return web.json_response({
             "object": "edit",
             "created": int(time.time()),
@@ -590,12 +607,13 @@ class API:
             "usage": resp.get("usage", {}),
         })
 
-    async def _loopback(self, path: str, body: dict) -> dict:
+    async def _loopback(self, path: str, body: dict,
+                        extra_headers: dict | None = None) -> dict:
         """POST to our own API (the reference's MCP agent does the same —
         mcp.go hands the local API address to the agent loop)."""
         import aiohttp
 
-        headers = {}
+        headers = dict(extra_headers or {})
         if self.cfg.api_keys:
             headers["Authorization"] = f"Bearer {self.cfg.api_keys[0]}"
         url = f"http://{self.cfg.address}{path}"

@@ -136,6 +136,40 @@ def test_chat_nonstream(stack):
     assert body["choices"][0]["finish_reason"] in ("length", "stop", "eos")
 
 
+def test_chat_extra_usage_header(stack):
+    """Extra-Usage request header (reference chat.go:47-50,191) merges the
+    in-band timings into `usage`, llama.cpp field names in ms."""
+    base, _ = stack
+    r = requests.post(base + "/v1/chat/completions", json={
+        "model": "tiny",
+        "messages": [{"role": "user", "content": "hello"}],
+        "max_tokens": 4,
+    }, headers={"Extra-Usage": "1"}, timeout=300)
+    assert r.status_code == 200, r.text
+    u = r.json()["usage"]
+    assert u["timing_token_generation"] > 0
+    assert "timing_prompt_processing" in u
+    # completions endpoint honors it too (reference completion.go:74)
+    rc = requests.post(base + "/v1/completions", json={
+        "model": "tiny", "prompt": "hello", "max_tokens": 4,
+    }, headers={"Extra-Usage": "1"}, timeout=300)
+    assert "timing_token_generation" in rc.json()["usage"]
+    # empty header value = disabled, matching the reference predicate
+    r0 = requests.post(base + "/v1/chat/completions", json={
+        "model": "tiny",
+        "messages": [{"role": "user", "content": "hello"}],
+        "max_tokens": 4,
+    }, headers={"Extra-Usage": ""}, timeout=300)
+    assert "timing_token_generation" not in r0.json()["usage"]
+    # absent header → plain OpenAI usage
+    r2 = requests.post(base + "/v1/chat/completions", json={
+        "model": "tiny",
+        "messages": [{"role": "user", "content": "hello"}],
+        "max_tokens": 4,
+    }, timeout=300)
+    assert "timing_token_generation" not in r2.json()["usage"]
+
+
 def test_chat_stream_sse(stack):
     base, _ = stack
     r = requests.post(base + "/v1/chat/completions", json={
