@@ -419,9 +419,10 @@ class API:
                     "token_generation_s": reply.timing_token_generation,
                 },
                 tool_calls=tool_calls)
-            schema.merge_extra_usage(resp, request,
-                                     reply.timing_prompt_processing,
-                                     reply.timing_token_generation)
+            schema.merge_extra_usage(
+                resp, bool(request.headers.get("Extra-Usage")),
+                reply.timing_prompt_processing,
+                reply.timing_token_generation)
             return web.json_response(resp)
         finally:
             handle.mark_idle()
@@ -479,7 +480,9 @@ class API:
             # OpenAI stream_options flag explicitly disables it
             tail = schema.chat_usage_chunk(rid, cfg.name, prompt_tokens,
                                            completion_tokens)
-            schema.merge_extra_usage(tail, request, t_prompt, t_gen)
+            schema.merge_extra_usage(
+                tail, bool(request.headers.get("Extra-Usage")),
+                t_prompt, t_gen)
             await send(tail)
         await resp.write(b"data: [DONE]\n\n")
         await resp.write_eof()
@@ -508,9 +511,10 @@ class API:
             out = schema.text_completion(
                 cfg.name, reply.message.decode("utf-8", "replace"),
                 reply.finish_reason, reply.prompt_tokens, reply.tokens)
-            schema.merge_extra_usage(out, request,
-                                     reply.timing_prompt_processing,
-                                     reply.timing_token_generation)
+            schema.merge_extra_usage(
+                out, bool(request.headers.get("Extra-Usage")),
+                reply.timing_prompt_processing,
+                reply.timing_token_generation)
             return web.json_response(out)
         finally:
             handle.mark_idle()
@@ -523,15 +527,26 @@ class API:
         await resp.prepare(request)
         rid = schema._id("cmpl")
         finish = "stop"
+        prompt_tokens = completion_tokens = 0
+        t_prompt = t_gen = 0.0
         async for reply in self._stream_rpc(handle, opts):
             text = reply.message.decode("utf-8", "replace")
+            prompt_tokens = reply.prompt_tokens
+            completion_tokens = reply.tokens
+            t_prompt = reply.timing_prompt_processing or t_prompt
+            t_gen = reply.timing_token_generation or t_gen
             if reply.finish_reason:
                 finish = reply.finish_reason
             if text:
                 await resp.write(
                     f"data: {json.dumps(schema.text_completion_chunk(rid, cfg.name, text))}\n\n".encode())
+        final = schema.text_completion_chunk(rid, cfg.name, "", finish)
+        if request.headers.get("Extra-Usage"):
+            # reference completion.go:74 parity on the stream too
+            final["usage"] = schema.usage(prompt_tokens, completion_tokens)
+            schema.merge_extra_usage(final, True, t_prompt, t_gen)
         await resp.write(
-            f"data: {json.dumps(schema.text_completion_chunk(rid, cfg.name, '', finish))}\n\n".encode())
+            f"data: {json.dumps(final)}\n\n".encode())
         await resp.write(b"data: [DONE]\n\n")
         await resp.write_eof()
         return resp

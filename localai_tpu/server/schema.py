@@ -11,13 +11,14 @@ def _id(prefix: str) -> str:
     return f"{prefix}-{uuid.uuid4().hex[:24]}"
 
 
-def merge_extra_usage(out: dict, request, t_prompt_s: float,
+def merge_extra_usage(out: dict, enabled: bool, t_prompt_s: float,
                       t_gen_s: float) -> dict:
     """Reference Extra-Usage opt-in (chat.go:47-50,191; completion.go:74;
-    edit.go:35): a NON-EMPTY `Extra-Usage` request header merges the
-    in-band timings into `usage`, llama.cpp field names in milliseconds."""
-    if request.headers.get("Extra-Usage"):
-        out["usage"].update({
+    edit.go:35): merge the in-band timings into `usage`, llama.cpp field
+    names in milliseconds. The header predicate (non-empty `Extra-Usage`)
+    lives at the endpoint layer — this is a pure body builder."""
+    if enabled:
+        out.setdefault("usage", {}).update({
             "timing_prompt_processing": (t_prompt_s or 0.0) * 1e3,
             "timing_token_generation": (t_gen_s or 0.0) * 1e3,
         })
