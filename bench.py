@@ -371,13 +371,144 @@ def bench_engine(args, size: str, on_cpu: bool):
     return statistics.median(tput), ttft_ms, context, dtype
 
 
+def bench_embed(args, size: str, on_cpu: bool):
+    """BASELINE config #3: /v1/embeddings-path throughput (served gRPC
+    Embedding RPC, batch inputs) → embeddings/s."""
+    import numpy as np
+
+    from localai_tpu.config import AppConfig, ModelConfig
+    from localai_tpu.core.manager import ModelManager
+
+    tmp = tempfile.mkdtemp(prefix="bench-ckpt-")
+    ckpt = write_synthetic_checkpoint(size, os.path.join(tmp, size))
+    # batched embeddings tokenize server-side: give the synthetic checkpoint
+    # an instant WordLevel tokenizer ("<n>" → id n, whitespace-split)
+    from tokenizers import Tokenizer, models, pre_tokenizers
+
+    vocab = SIZES[size]["vocab_size"]
+    tok = Tokenizer(models.WordLevel(
+        {str(i): i for i in range(min(vocab, 1000))}, unk_token="0"))
+    tok.pre_tokenizer = pre_tokenizers.WhitespaceSplit()
+    tok.save(os.path.join(ckpt, "tokenizer.json"))
+    with open(os.path.join(ckpt, "tokenizer_config.json"), "w") as fh:
+        json.dump({"bos_token": None, "eos_token": None,
+                   "add_bos_token": False}, fh)
+    os.environ["LOCALAI_ALLOW_SYNTHETIC"] = "1"
+    dtype = args.dtype or ("float32" if on_cpu else "bfloat16")
+    if on_cpu:
+        os.environ["LOCALAI_JAX_PLATFORM"] = "cpu"
+    mcfg = ModelConfig.from_dict({
+        "name": f"bench-{size}", "backend": "llm", "context_size": 512,
+        "parallel": 2, "dtype": dtype, "embeddings": True,
+        "prefill_buckets": [128], "parameters": {"model": ckpt},
+    })
+    manager = ModelManager(AppConfig(models_path=tmp))
+    handle = manager.load(mcfg)
+    rng = np.random.default_rng(0)
+    batch = [" ".join(str(t) for t in rng.integers(1, min(vocab, 999), 24))
+             for _ in range(args.embed_batch)]
+    try:
+        handle.client.embedding(prompts=batch)      # warmup (compile)
+        rates = []
+        for _ in range(args.windows):
+            t0 = time.perf_counter()
+            r = handle.client.embedding(prompts=batch)
+            dt = time.perf_counter() - t0
+            n = len(r.vectors) or len(batch)
+            rates.append(n / dt)
+            note(f"embed window: {rates[-1]:.1f} embeddings/s ({n} x 24 tok)")
+    finally:
+        # never leak the accelerator-holding backend into later ladder
+        # stages, and never leave checkpoints accumulating in /tmp
+        import shutil
+
+        manager.stop_all()
+        shutil.rmtree(tmp, ignore_errors=True)
+    return statistics.median(rates)
+
+
+def bench_whisper(args, on_cpu: bool):
+    """BASELINE config #4: /v1/audio/transcriptions real-time factor
+    (audio-seconds transcribed per wall-second) through the whisper backend."""
+    import numpy as np
+    import torch
+    from transformers import WhisperConfig, WhisperForConditionalGeneration
+
+    from localai_tpu.config import AppConfig, ModelConfig
+    from localai_tpu.core.manager import ModelManager
+
+    if on_cpu:
+        os.environ["LOCALAI_JAX_PLATFORM"] = "cpu"
+    tmp = tempfile.mkdtemp(prefix="bench-whisper-")
+    torch.manual_seed(0)
+    if on_cpu:
+        # CPU smoke: tiny geometry + short clip (whisper-base on CPU f32
+        # takes minutes per window — harness validation only)
+        wcfg = WhisperConfig(
+            vocab_size=51865, d_model=64, encoder_layers=2,
+            decoder_layers=2, encoder_attention_heads=4,
+            decoder_attention_heads=4, encoder_ffn_dim=128,
+            decoder_ffn_dim=128, num_mel_bins=80,
+            max_source_positions=1500, max_target_positions=64)
+    else:
+        # whisper-base geometry (the BASELINE config names whisper-base)
+        wcfg = WhisperConfig(
+            vocab_size=51865, d_model=512, encoder_layers=6,
+            decoder_layers=6, encoder_attention_heads=8,
+            decoder_attention_heads=8, encoder_ffn_dim=2048,
+            decoder_ffn_dim=2048, num_mel_bins=80,
+            max_source_positions=1500, max_target_positions=448)
+    m = WhisperForConditionalGeneration(wcfg)
+    m.generation_config.forced_decoder_ids = None
+    m.generation_config.suppress_tokens = None
+    m.generation_config.begin_suppress_tokens = None
+    m.save_pretrained(tmp, safe_serialization=True)
+    mcfg = ModelConfig.from_dict({
+        "name": "bench-whisper", "backend": "whisper",
+        "parameters": {"model": tmp},
+    })
+    manager = ModelManager(AppConfig(models_path=tmp))
+    handle = manager.load(mcfg)
+    secs = 5.0 if on_cpu else 20.0
+    sr = 16000
+    t = np.arange(int(secs * sr)) / sr
+    pcm = (0.1 * np.sin(2 * np.pi * 220 * t)).astype(np.float32)
+    import struct
+    import wave
+
+    wav = os.path.join(tmp, "in.wav")
+    with wave.open(wav, "wb") as w:
+        w.setnchannels(1)
+        w.setsampwidth(2)
+        w.setframerate(sr)
+        w.writeframes(
+            struct.pack(f"<{len(pcm)}h",
+                        *(np.clip(pcm, -1, 1) * 32767).astype(np.int16)))
+    try:
+        handle.client.transcribe(dst=wav, language="en")     # warmup
+        rtfs = []
+        for _ in range(args.windows):
+            t0 = time.perf_counter()
+            handle.client.transcribe(dst=wav, language="en")
+            rtfs.append(secs / (time.perf_counter() - t0))
+            note(f"whisper window: RTF {rtfs[-1]:.2f}x")
+    finally:
+        import shutil
+
+        manager.stop_all()
+        shutil.rmtree(tmp, ignore_errors=True)
+    return statistics.median(rtfs)
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--size", default=None,
                    help="tiny|1b|3b|8b (default: 8b on TPU, tiny on CPU)")
-    p.add_argument("--mode", default="serve", choices=["serve", "engine"],
-                   help="serve = gRPC backend subprocess (default); "
-                        "engine = in-process")
+    p.add_argument("--mode", default="serve",
+                   choices=["serve", "engine", "embed", "whisper"],
+                   help="serve = gRPC backend subprocess (default); engine = "
+                        "in-process; embed/whisper = BASELINE configs #3/#4")
+    p.add_argument("--embed-batch", type=int, default=256)
     p.add_argument("--dtype", default=None,
                    help="override weights dtype (default: int8 for 8b, else bf16)")
     p.add_argument("--cpu", action="store_true", help="force CPU (local smoke)")
@@ -405,6 +536,29 @@ def main(argv=None):
             dtype = args.dtype or "float32"
         args.slots = 16 if dtype in ("int8", "int4") else 8
 
+    if args.mode == "embed":
+        rate = bench_embed(args, size, on_cpu)
+        out = {
+            "metric": f"embeddings/s (llama-{size}, served Embedding RPC, "
+                      f"batch {args.embed_batch} x 24 tok) [BASELINE #3]",
+            "value": round(rate, 2), "unit": "embeddings/s",
+            "vs_baseline": None, "device": device_kind}
+        if on_cpu and not args.cpu:
+            out["probe_error"] = probe_error[:500]
+        print(json.dumps(out))
+        return 0
+    if args.mode == "whisper":
+        rtf = bench_whisper(args, on_cpu)
+        geom = "tiny-smoke, 5 s" if on_cpu else "whisper-base, 20 s"
+        out = {
+            "metric": f"whisper RTF ({geom} clip, served "
+                      f"AudioTranscription) [BASELINE #4]",
+            "value": round(rtf, 2), "unit": "audio-s/s",
+            "vs_baseline": None, "device": device_kind}
+        if on_cpu and not args.cpu:
+            out["probe_error"] = probe_error[:500]
+        print(json.dumps(out))
+        return 0
     if args.mode == "serve":
         # the parent process stays JAX-free: the backend subprocess owns the
         # accelerator, exactly like production serving

@@ -81,6 +81,16 @@ while true; do
       log "stage 8: sampling profile"
       timeout 1800 python tools/profile_sampling.py > bench_runs/sampling.log 2>&1
       log "stage 8 rc=$?"
+
+      log "stage 9: embeddings throughput (BASELINE #3)"
+      timeout 1800 python bench.py --mode embed --size 1b \
+        > bench_runs/embed.json 2> bench_runs/embed.log
+      log "stage 9 rc=$? ($(cat bench_runs/embed.json))"
+
+      log "stage 10: whisper RTF (BASELINE #4)"
+      timeout 1800 python bench.py --mode whisper \
+        > bench_runs/whisper.json 2> bench_runs/whisper.log
+      log "stage 10 rc=$? ($(cat bench_runs/whisper.json))"
       log "ladder complete"
       break
     fi
