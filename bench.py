@@ -167,6 +167,11 @@ def bench_serve(args, size: str, on_cpu: bool):
     tmp = tempfile.mkdtemp(prefix="bench-ckpt-")
     ckpt = write_synthetic_checkpoint(size, os.path.join(tmp, size))
     os.environ["LOCALAI_ALLOW_SYNTHETIC"] = "1"  # inherited by the backend
+    # the bench runs its own warmup phase and measures TTFT after it; the
+    # backend's LoadModel prewarm would re-pay the same compiles inside the
+    # 600 s LoadModel deadline (and on a TPU the grown variant set could
+    # blow it) — disable for the spawned backend
+    os.environ["LOCALAI_NO_PREWARM"] = "1"
     dtype = args.dtype or ("int8" if size == "8b" else "bfloat16")
     if on_cpu:
         dtype = args.dtype or "float32"
@@ -394,6 +399,7 @@ def bench_embed(args, size: str, on_cpu: bool):
         json.dump({"bos_token": None, "eos_token": None,
                    "add_bos_token": False}, fh)
     os.environ["LOCALAI_ALLOW_SYNTHETIC"] = "1"
+    os.environ["LOCALAI_NO_PREWARM"] = "1"   # embed RPC needs no decode warm
     dtype = args.dtype or ("float32" if on_cpu else "bfloat16")
     if on_cpu:
         os.environ["LOCALAI_JAX_PLATFORM"] = "cpu"
