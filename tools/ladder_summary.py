@@ -1,0 +1,43 @@
+"""Summarize bench_runs/*.json into a markdown table (TPU_VALIDATION.md
+fodder once the watcher ladder completes)."""
+import glob
+import json
+import os
+
+ORDER = ["bench16b", "bench32d", "bench32b", "bench48d", "eng32p", "eng32d",
+         "bench8k", "embed", "whisper"]
+
+
+def main():
+    rows = []
+    for name in ORDER:
+        path = os.path.join("bench_runs", name + ".json")
+        if not os.path.exists(path):
+            continue
+        try:
+            with open(path) as f:
+                d = json.load(f)
+        except Exception:
+            continue
+        rows.append((name, d))
+    if not rows:
+        print("no ladder results yet")
+        return
+    print("| stage | metric | value | unit | TTFT p50 | MFU | device |")
+    print("|---|---|---|---|---|---|---|")
+    for name, d in rows:
+        print(f"| {name} | {d.get('metric', '?')} | {d.get('value')} | "
+              f"{d.get('unit')} | {d.get('ttft_p50_ms', '—')} | "
+              f"{d.get('mfu', '—')} | {d.get('device')} |")
+    for extra in ("rtt.log", "attn_sweep.log", "bisect.log", "sampling.log"):
+        p = os.path.join("bench_runs", extra)
+        if os.path.exists(p):
+            print(f"\n--- {extra} ---")
+            with open(p) as f:
+                for line in f.read().splitlines()[-12:]:
+                    if "WARNING" not in line:
+                        print(line)
+
+
+if __name__ == "__main__":
+    main()
