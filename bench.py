@@ -286,7 +286,7 @@ def bench_serve(args, size: str, on_cpu: bool):
 
 # --------------------------------------------------------------- engine mode
 
-def bench_engine(args, size: str, on_cpu: bool):
+def bench_engine(args, size: str, on_cpu: bool, kv_pages: int | None = None):
     """In-process Engine measurement (no RPC overhead) — kernel ceiling."""
     import jax
     import numpy as np
@@ -314,7 +314,7 @@ def bench_engine(args, size: str, on_cpu: bool):
         # mirror bench_serve's KV config (was silently dense-bf16 before:
         # 32-slot engine-mode runs OOM'd at admit compile)
         cache_type="int8" if dtype in ("int8", "int4") else "",
-        kv_pages=args.kv_pages,
+        kv_pages=args.kv_pages if kv_pages is None else kv_pages,
     ))
     rng = np.random.default_rng(0)
 
@@ -374,6 +374,32 @@ def bench_engine(args, size: str, on_cpu: bool):
 
     shutil.rmtree(tmp, ignore_errors=True)
     return statistics.median(tput), ttft_ms, context, dtype
+
+
+def bench_paged(args, size: str, on_cpu: bool):
+    """Dense vs paged, SAME workload, ONE process — the regression guard
+    VERDICT Weak #2 asked for. Runs the in-process engine measurement twice
+    (kv_pages=0, then a pool sized for the workload) and reports the ratio:
+    a paged_over_dense well below 1.0 is the pool-rematerialization bug
+    pattern and must never ship silently again."""
+    from localai_tpu.ops.paged import BLOCK
+
+    dense_tps, dense_ttft, context, dtype = bench_engine(
+        args, size, on_cpu, kv_pages=0)
+    note(f"dense: {dense_tps:.1f} tok/s")
+    pages = args.kv_pages
+    if not pages:
+        # reservation per slot: prompt + max_tokens + the engine's in-flight
+        # margin (2*decode_block+1 == 33 at the default block of 16),
+        # capped at the context — mirror engine._blocks_for + trash block
+        tokens = min(args.prompt_len + args.decode_steps + 33, context)
+        pages = args.slots * (-(-tokens // BLOCK)) + 1
+    note(f"paged pool: {pages} blocks")
+    paged_tps, paged_ttft, _, _ = bench_engine(
+        args, size, on_cpu, kv_pages=pages)
+    note(f"paged: {paged_tps:.1f} tok/s "
+         f"({paged_tps / max(dense_tps, 1e-9):.2f}x dense)")
+    return dense_tps, dense_ttft, paged_tps, paged_ttft, pages, context, dtype
 
 
 def bench_embed(args, size: str, on_cpu: bool):
@@ -511,9 +537,11 @@ def main(argv=None):
     p.add_argument("--size", default=None,
                    help="tiny|1b|3b|8b (default: 8b on TPU, tiny on CPU)")
     p.add_argument("--mode", default="serve",
-                   choices=["serve", "engine", "embed", "whisper"],
+                   choices=["serve", "engine", "embed", "whisper", "paged"],
                    help="serve = gRPC backend subprocess (default); engine = "
-                        "in-process; embed/whisper = BASELINE configs #3/#4")
+                        "in-process; paged = dense AND paged in one process "
+                        "with a paged_over_dense ratio; embed/whisper = "
+                        "BASELINE configs #3/#4")
     p.add_argument("--embed-batch", type=int, default=256)
     p.add_argument("--dtype", default=None,
                    help="override weights dtype (default: int8 for 8b, else bf16)")
@@ -564,6 +592,37 @@ def main(argv=None):
         if on_cpu and not args.cpu:
             out["probe_error"] = probe_error[:500]
         print(json.dumps(out))
+        return 0
+    if args.mode == "paged":
+        import jax
+
+        if on_cpu:
+            jax.config.update("jax_platforms", "cpu")
+        note("initializing device client...")
+        dev = jax.devices()[0]
+        device_kind = getattr(dev, "device_kind", dev.platform)
+        (dense_tps, dense_ttft, toks_per_s, ttft_ms, pages, context,
+         dtype) = bench_paged(args, size, on_cpu)
+        n_params = param_count(size)
+        mfu = (toks_per_s * 2 * n_params) / peak_flops_per_chip(device_kind)
+        result = {
+            "metric": f"decode tok/s/chip (llama-{size} {dtype}, paged "
+                      f"{pages} blocks vs dense, {args.slots} slots, "
+                      f"ctx {context})",
+            "value": round(toks_per_s, 2),
+            "unit": "tok/s",
+            "vs_baseline": None if on_cpu else round(toks_per_s / 1000.0, 4),
+            "dense_tok_s": round(dense_tps, 2),
+            "paged_over_dense": round(toks_per_s / max(dense_tps, 1e-9), 4),
+            "ttft_p50_ms": round(ttft_ms, 2),
+            "dense_ttft_p50_ms": round(dense_ttft, 2),
+            "mfu": None if on_cpu else round(mfu, 4),
+            "device": device_kind,
+            "params": n_params,
+        }
+        if on_cpu and not args.cpu:
+            result["probe_error"] = probe_error[:500]
+        print(json.dumps(result))
         return 0
     if args.mode == "serve":
         # the parent process stays JAX-free: the backend subprocess owns the

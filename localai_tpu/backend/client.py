@@ -23,6 +23,13 @@ class BackendClient:
         self._channel = grpc.insecure_channel(addr, options=[
             ("grpc.max_receive_message_length", 128 * 1024 * 1024),
             ("grpc.max_send_message_length", 128 * 1024 * 1024),
+            # spawn-time poll: the first connects race the child's bind, and
+            # gRPC's default reconnect backoff then grows toward minutes —
+            # longer than the whole health budget. Cap it; this channel only
+            # ever talks to a subprocess on loopback.
+            ("grpc.initial_reconnect_backoff_ms", 250),
+            ("grpc.min_reconnect_backoff_ms", 250),
+            ("grpc.max_reconnect_backoff_ms", 2000),
         ])
         self._calls = {}
         sym = pb._pb2
@@ -48,17 +55,21 @@ class BackendClient:
 
     # ------------------------------------------------------------ health
 
-    def health(self, timeout: float = 5.0) -> bool:
+    def health(self, timeout: float = 5.0, wait: bool = False) -> bool:
         try:
-            r = self._calls["Health"](pb.HealthMessage(), timeout=timeout)
+            r = self._calls["Health"](pb.HealthMessage(), timeout=timeout,
+                                      wait_for_ready=wait)
             return r.message == b"OK"
         except grpc.RpcError:
             return False
 
     def wait_ready(self, attempts: int = 60, sleep: float = 0.5) -> bool:
-        """Spawn-time health poll (reference initializers.go:110-129)."""
+        """Spawn-time health poll (reference initializers.go:110-129).
+        wait_for_ready queues the RPC until the channel connects (instead of
+        failing fast from backoff state), so a slow child startup costs one
+        deadline, not the whole budget."""
         for _ in range(attempts):
-            if self.health(timeout=2.0):
+            if self.health(timeout=2.0, wait=True):
                 return True
             time.sleep(sleep)
         return False

@@ -71,6 +71,38 @@ def _add_tts(sub):
     return p
 
 
+def _add_soundgeneration(sub):
+    p = sub.add_parser("soundgeneration",
+                       help="generate audio from a text description "
+                            "(reference core/cli/soundgeneration.go)")
+    p.add_argument("text", help="description of the sound to generate")
+    p.add_argument("--model", default="default-tts")
+    p.add_argument("--duration", type=float, default=2.0,
+                   help="clip length in seconds")
+    p.add_argument("--temperature", type=float, default=1.0)
+    p.add_argument("--output-file", default="output.wav")
+    p.add_argument("--models-path", default="models")
+    return p
+
+
+def cli_soundgeneration(args) -> int:
+    manager, handle = _one_shot_handle(args.model, args.models_path, "tts")
+    try:
+        import os
+
+        dst = os.path.abspath(args.output_file)
+        r = handle.client.sound_generation(
+            text=args.text, duration=args.duration,
+            temperature=args.temperature, dst=dst)
+        if not r.success:
+            print(f"sound generation failed: {r.message}")
+            return 1
+        print(dst)
+        return 0
+    finally:
+        manager.stop_all()
+
+
 def _add_transcript(sub):
     p = sub.add_parser("transcript",
                        help="transcribe an audio file "
@@ -322,6 +354,7 @@ def main(argv=None):
     _add_federated(sub)
     _add_worker(sub)
     _add_tts(sub)
+    _add_soundgeneration(sub)
     _add_transcript(sub)
     sub.add_parser("version", help="print version")
 
@@ -366,6 +399,8 @@ def main(argv=None):
         return run_worker(args)
     if cmd == "tts":
         return cli_tts(args)
+    if cmd == "soundgeneration":
+        return cli_soundgeneration(args)
     if cmd == "transcript":
         return cli_transcript(args)
     if cmd == "run":

@@ -303,6 +303,17 @@ class Engine:
             self._kv_free: list[int] = list(range(1, self.ec.kv_pages))
             self._slot_blocks: list[list[int]] = [[] for _ in range(B)]
             self._released_lru: list[int] = []
+            # block-level prefix cache: refcounted shared pages. A block's
+            # refcount is the number of slot block-lists (live or released-
+            # retained) holding it; the chain-hash index maps a full
+            # 128-token content prefix to the physical block still storing
+            # its K/V, letting a new admission map another tenant's pages
+            # straight into its table (copy-on-write: borrowed pages are
+            # never written — see _alloc_slot).
+            self._block_ref = np.zeros(self.ec.kv_pages, np.int64)
+            self._block_ref[0] = 1          # trash block: pinned forever
+            self._hash_index: dict[bytes, int] = {}
+            self._block_hash_of: dict[int, bytes] = {}
         self._deferred: tuple | None = None   # admission waiting on blocks
         self._admitting: tuple | None = None  # admission mid-device-call
         self._blocks_freed = False
@@ -949,12 +960,31 @@ class Engine:
         # multimodal: id-level prefix reuse would match the repeated image
         # token while the injected features differ — no slot or disk reuse
         slot, lcp = self._pick_slot([] if mm else req.prompt_ids)
-        if self._paged and not self._alloc_slot(slot, req):
-            # pool exhausted even after reclaim: defer (FIFO) until blocks
-            # free — the caller re-attempts on later ticks
-            self._free.append(slot)
-            self._deferred = (rid, req, out)
-            return None
+        if self._paged:
+            shared = None
+            if req.context_shift:
+                # a shift rotates this slot's pages IN PLACE — never run it
+                # over pages other tenants read: no borrowed pages, and
+                # lcp=0 makes _alloc_slot's copy-on-write pass swap every
+                # externally-shared retained block before the cold prefill
+                lcp = 0
+            elif self.ec.prompt_cache and self._draft is None and not mm:
+                # block-level prefix cache: another tenant's pages beat the
+                # slot-retained token match when they cover more prefix
+                shared, shtok = self._match_prefix_blocks(req.prompt_ids)
+                if shtok > lcp:
+                    lcp = shtok
+                else:
+                    self._unref_blocks(shared)
+                    shared = None
+            eff = self._alloc_slot(slot, req, shared=shared, lcp=lcp)
+            if eff is None:
+                # pool exhausted even after reclaim: defer (FIFO) until
+                # blocks free — the caller re-attempts on later ticks
+                self._free.append(slot)
+                self._deferred = (rid, req, out)
+                return None
+            lcp = eff
         self._slot_kv_tokens[slot] = []
         disk_prefix = 0
         if not lcp and req.prompt_cache_path and not mm:
@@ -1486,7 +1516,11 @@ class Engine:
     # generation can never exhaust the pool mid-flight — oversubscription
     # comes from max_tokens being much smaller than max_context. Released
     # slots RETAIN their blocks (the warm prefix cache) until the pool runs
-    # short, then the least-recently-released slot is reclaimed.
+    # short, then the least-recently-released slot is reclaimed. On top of
+    # that, full 128-token blocks are content-hash-indexed at release, so a
+    # NEW admission sharing the prompt prefix maps the same physical pages
+    # into its own table (refcounted, copy-on-write: a borrower only ever
+    # writes positions past the shared prefix, which live in fresh blocks).
 
     def _blocks_for(self, req: GenRequest) -> int:
         from localai_tpu.ops.paged import blocks_needed
@@ -1501,9 +1535,74 @@ class Engine:
                      self.ec.max_context)
         return blocks_needed(tokens)
 
+    def _ref_blocks(self, blocks):
+        for pb in blocks:
+            self._block_ref[pb] += 1
+
+    def _unref_blocks(self, blocks):
+        """Drop one reference from each block; blocks reaching zero return
+        to the free pool (their content is dead — any hash entry with it)."""
+        freed = False
+        for pb in blocks:
+            self._block_ref[pb] -= 1
+            if self._block_ref[pb] <= 0:
+                self._block_ref[pb] = 0
+                self._drop_hash(pb)
+                self._kv_free.append(pb)
+                freed = True
+        if freed:
+            self._blocks_freed = True
+
+    def _drop_hash(self, pb: int):
+        """Forget a block's registered content (freed or about to be
+        rewritten) so the prefix index can never serve stale pages."""
+        h = self._block_hash_of.pop(pb, None)
+        if h is not None and self._hash_index.get(h) == pb:
+            del self._hash_index[h]
+
+    @staticmethod
+    def _chain_hashes(ids) -> list[bytes]:
+        """Chain content hashes of consecutive full 128-token blocks: the
+        hash of block v commits to every token before it, so equal hash ⇒
+        equal whole prefix AND equal absolute positions (K rows are stored
+        post-RoPE — position-dependent — which a flat per-block hash would
+        get wrong)."""
+        import hashlib
+
+        from localai_tpu.ops.paged import BLOCK
+
+        h = b""
+        out = []
+        for vb in range(len(ids) // BLOCK):
+            blk = np.asarray(ids[vb * BLOCK:(vb + 1) * BLOCK], np.int64)
+            h = hashlib.blake2b(h + blk.tobytes(), digest_size=16).digest()
+            out.append(h)
+        return out
+
+    def _match_prefix_blocks(self, prompt_ids) -> tuple[list[int], int]:
+        """Block-level prefix cache lookup: the longest run of leading full
+        128-token blocks whose chain hash is registered. Matched blocks are
+        ref'd for the caller — commit them via _alloc_slot(shared=...) or
+        return them with _unref_blocks on any bail-out.
+        Returns (physical blocks, tokens covered)."""
+        from localai_tpu.ops.paged import BLOCK
+
+        limit = self.ec.max_context - 2 - self._ctx_reserve
+        nfull = min(len(prompt_ids) - 1, limit - 1) // BLOCK
+        blocks: list[int] = []
+        for h in self._chain_hashes(prompt_ids[:nfull * BLOCK]):
+            pb = self._hash_index.get(h)
+            if pb is None:
+                break
+            blocks.append(pb)
+        self._ref_blocks(blocks)
+        return blocks, len(blocks) * BLOCK
+
     def _take_blocks(self, k: int, keep_slot: int):
-        """Pop k free blocks, reclaiming released slots' retained blocks
-        (oldest first, never `keep_slot` — its prefix is being reused).
+        """Pop k free blocks (ref'd for the caller), reclaiming released
+        slots' retained blocks (oldest first, never `keep_slot` — its prefix
+        is being reused). A victim's pages that other tenants still share
+        stay alive (refcount) — only its last reference frees a block.
         Returns None when the pool genuinely cannot satisfy k."""
         while len(self._kv_free) < k:
             victim = next((s for s in self._released_lru if s != keep_slot),
@@ -1511,32 +1610,82 @@ class Engine:
             if victim is None:
                 return None
             self._released_lru.remove(victim)
-            self._kv_free.extend(self._slot_blocks[victim])
+            self._unref_blocks(self._slot_blocks[victim])
             self._slot_blocks[victim] = []
             self._slot_kv_tokens[victim] = []
             self._table[victim, :] = 0
         out = self._kv_free[:k]
         del self._kv_free[:k]
+        self._ref_blocks(out)
         return out
 
-    def _alloc_slot(self, slot: int, req: GenRequest) -> bool:
-        """Size `slot`'s block list for `req` (keeping any retained prefix
-        blocks); update the table row. False = pool exhausted (defer)."""
+    def _alloc_slot(self, slot: int, req: GenRequest, shared=None,
+                    lcp: int = 0):
+        """Size `slot`'s block list for `req`; update the table row.
+
+        `shared`: already-ref'd physical blocks from _match_prefix_blocks —
+        they become the slot's head (the borrowed prefix pages). `lcp`: the
+        token prefix the request will NOT rewrite (slot-retained or shared
+        reuse). Returns the EFFECTIVE reusable prefix length (may shrink —
+        see the copy-on-write pass), or None when the pool is exhausted
+        (defer; `shared` refs are returned here on that path)."""
+        from localai_tpu.ops.paged import BLOCK
+
         need = self._blocks_for(req)
         have = self._slot_blocks[slot]
-        if len(have) < need:
-            got = self._take_blocks(need - len(have), keep_slot=slot)
-            if got is None:
-                return False
-            have.extend(got)
-        elif len(have) > need:
-            self._kv_free.extend(have[need:])
-            del have[need:]
+        if shared is not None:
+            fresh = self._take_blocks(need - len(shared), keep_slot=slot) \
+                if need > len(shared) else []
+            if fresh is None:
+                self._unref_blocks(shared)
+                return None
+            self._unref_blocks(have)
+            have = list(shared) + fresh
+            self._slot_blocks[slot] = have
+        else:
+            old_len = len(have)
+            if len(have) < need:
+                got = self._take_blocks(need - len(have), keep_slot=slot)
+                if got is None:
+                    return None
+                have.extend(got)
+            elif len(have) > need:
+                self._unref_blocks(have[need:])
+                del have[need:]
+            # copy-on-write: every block from the first written one onward
+            # gets rewritten by this request. A page another tenant still
+            # reads (ref > 1) must not be written in place — swap in a
+            # fresh block. Context-shift requests rotate even their prefix
+            # blocks, so for them EVERY shared page swaps (lcp arrives 0).
+            j0 = lcp // BLOCK
+            swap = [j for j in range(j0, len(have))
+                    if self._block_ref[have[j]] > 1]
+            if swap:
+                got = self._take_blocks(len(swap), keep_slot=slot)
+                if got is None:
+                    # roll the extension back: a deferred slot must not sit
+                    # on fresh blocks the retry (or another request) needs
+                    if len(have) > old_len:
+                        self._unref_blocks(have[old_len:])
+                        del have[old_len:]
+                    return None
+                for j, nb in zip(swap, got):
+                    self._unref_blocks([have[j]])
+                    have[j] = nb
+                if swap[0] == j0:
+                    # the partially-reused block itself was swapped: the
+                    # rows [j0*BLOCK, lcp) went with it
+                    lcp = j0 * BLOCK
+        # the to-be-written blocks' old content is dead the moment the
+        # first new row lands — their hash entries must go now, or the
+        # index would hand out pages mid-rewrite
+        for j in range(lcp // BLOCK, len(have)):
+            self._drop_hash(have[j])
         self._table[slot, :] = 0
         self._table[slot, :len(have)] = have
         if slot in self._released_lru:
             self._released_lru.remove(slot)
-        return True
+        return lcp
 
     def _pick_slot(self, prompt_ids: list[int]) -> tuple[int, int]:
         """Choose a free slot, preferring one whose cached tokens share the
@@ -1684,12 +1833,25 @@ class Engine:
                 keep = blocks_needed(kept)
                 blocks = self._slot_blocks[idx]
                 if len(blocks) > keep:
-                    self._kv_free.extend(blocks[keep:])
+                    self._unref_blocks(blocks[keep:])
                     del blocks[keep:]
                     self._table[idx, keep:] = 0
+                # register every FULL block in the content-hash index: a
+                # future admission sharing the prefix maps these pages into
+                # its own table (block-level prefix cache). Multimodal rows
+                # are excluded for the same reason as the token record
+                # below — identical image-token ids, different KV.
+                if slot.req.mm_embeds is None:
+                    ids = (list(slot.req.prompt_ids) + slot.gen_ids)[:kept]
+                    for vb, h in enumerate(self._chain_hashes(ids)):
+                        pb = blocks[vb]
+                        if h not in self._hash_index:
+                            self._drop_hash(pb)
+                            self._hash_index[h] = pb
+                            self._block_hash_of[pb] = h
                 self._released_lru.append(idx)
             else:
-                self._kv_free.extend(self._slot_blocks[idx])
+                self._unref_blocks(self._slot_blocks[idx])
                 self._slot_blocks[idx] = []
                 self._table[idx, :] = 0
             self._blocks_freed = True

@@ -379,6 +379,30 @@ def _decode_dq(q, kc, vc, lengths, sliding_window=None, table=None):
                       sliding_window=sliding_window)
 
 
+def _pallas_paged_scatter(cfg: LlamaConfig | None, kv_quant: bool) -> bool:
+    """Whether the paged decode write should use the Pallas scatter-append
+    kernel (ops/pallas/paged_scatter.py) instead of the XLA scatter. Same
+    tier selection as _attn_impls' decode branch: Pallas on single-chip TPU
+    (probe-gated) or under LOCALAI_FORCE_PALLAS; XLA under a mesh (the pool
+    shards its KV-head axis there — the kernel assumes a local pool), on
+    CPU, and under LOCALAI_NO_PALLAS."""
+    import os
+
+    from localai_tpu.parallel.mesh import current_mesh
+
+    if os.environ.get("LOCALAI_FORCE_PALLAS") == "1":
+        return True
+    if (os.environ.get("LOCALAI_NO_PALLAS") == "1"
+            or jax.default_backend() != "tpu" or current_mesh() is not None):
+        return False
+    from localai_tpu.ops.pallas import pallas_works
+
+    if cfg is not None:
+        return pallas_works(cfg.num_heads, cfg.num_kv_heads, cfg.head_dim,
+                            cfg.sliding_window, cfg.jdtype, kv_quant=kv_quant)
+    return pallas_works(kv_quant=kv_quant)
+
+
 def _attn_impls(cfg: LlamaConfig | None = None, kv_quant: bool = False):
     """Select attention kernels at trace time: Pallas (fused, online-softmax)
     on single-chip TPU; XLA reference under a mesh (GSPMD shards the einsums)
@@ -505,8 +529,9 @@ def decode_step(params, cfg: LlamaConfig, tokens, lengths, cos, sin,
     Returns (logits [B, V] f32, k_cache, v_cache).
     """
     b = tokens.shape[0]
+    kv_quant = isinstance(k_cache, QuantKV)
     T = k_cache.shape[3] if table is None else table.shape[1] * 128
-    _, attn_decode = _attn_impls(cfg, kv_quant=isinstance(k_cache, QuantKV))
+    _, attn_decode = _attn_impls(cfg, kv_quant=kv_quant)
     positions = lengths[:, None]  # [B,1]
     if active is None:
         wpos, redirect = positions, None
@@ -520,6 +545,11 @@ def decode_step(params, cfg: LlamaConfig, tokens, lengths, cos, sin,
         # whose last virtual block can be a RETAINED warm-prefix block
         wpos, redirect = positions, ~active
     unique = table is None or b <= 128
+    # paged Pallas tier: the per-step write is a scatter-append DMA kernel
+    # (O(slots) traffic, provably in place) instead of an XLA scatter
+    # through gathered physical indices — the scatter XLA de-optimizes into
+    # a full-pool copy inside the fused decode block (VERDICT Weak #2)
+    kernel_write = table is not None and _pallas_paged_scatter(cfg, kv_quant)
     x = params["embed"].astype(cfg.jdtype)[tokens][:, None, :]  # [B,1,H]
 
     def layer(x, xs):
@@ -528,8 +558,22 @@ def decode_step(params, cfg: LlamaConfig, tokens, lengths, cos, sin,
         q, k, v = _qkv(h, lp, cfg)
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
-        kc, vc = _cache_write(kc, vc, k, v, jnp.arange(b), wpos, table,
-                              unique=unique, redirect=redirect)
+        if kernel_write:
+            from localai_tpu.ops.pallas import (
+                paged_scatter_append, paged_scatter_append_q8,
+            )
+
+            if kv_quant:
+                kq, ks, vq, vs = paged_scatter_append_q8(
+                    kc.q, kc.s, vc.q, vc.s, k[:, 0], v[:, 0], lengths,
+                    table, active)
+                kc, vc = QuantKV(kq, ks), QuantKV(vq, vs)
+            else:
+                kc, vc = paged_scatter_append(kc, vc, k[:, 0], v[:, 0],
+                                              lengths, table, active)
+        else:
+            kc, vc = _cache_write(kc, vc, k, v, jnp.arange(b), wpos, table,
+                                  unique=unique, redirect=redirect)
         attn = attn_decode(q, kc, vc, lengths + 1,
                            sliding_window=cfg.sliding_window, table=table)
         x = x + qmatmul(attn.reshape(b, 1, -1), lp["wo"])

@@ -19,7 +19,10 @@ The XLA (non-Pallas) attention paths read the cache through `dequant`, which
 XLA fuses into the consuming dot where it can; HBM *capacity* is halved
 either way, and the int8 Pallas decode kernel
 (ops/pallas/flash_attention.py:ragged_decode_q8) also halves decode HBM
-*traffic* — the thing decode is actually bound by.
+*traffic* — the thing decode is actually bound by. On the paged Pallas tier
+the per-step cache WRITE quantizes through `quantize_tokens` and lands via
+the scatter-append DMA kernel (ops/pallas/paged_scatter.py) instead of
+`cache_scatter`'s XLA scatter.
 """
 from __future__ import annotations
 

@@ -19,9 +19,11 @@ exceed its allocation — the engine reserves blocks for prompt + max_tokens
 at admission, which is also why generation can never run out mid-flight).
 
 The Pallas decode kernels stream KV blocks through the table with a
-scalar-prefetched index map (ops/pallas/flash_attention.py) — traffic stays
-O(valid tokens). The XLA reference paths below materialize the virtual view
-with a gather; that is the CPU-test / fallback tier, not the TPU hot path.
+scalar-prefetched index map (ops/pallas/flash_attention.py), and the decode
+WRITE is a scatter-append DMA kernel (ops/pallas/paged_scatter.py) — traffic
+stays O(valid tokens)/O(slots). The XLA reference paths below materialize
+the virtual view with a gather; that is the CPU-test / fallback tier, not
+the TPU hot path (asserted by tests/test_paged_fast_path.py).
 
 int8 storage reuses ops/kvcache.QuantKV verbatim: with BS == SCALE_TILE the
 per-block scale row is [1, 128] and `cache_scatter`'s tok//128, tok%128

@@ -5,3 +5,7 @@ from localai_tpu.ops.pallas.flash_attention import (  # noqa: F401
     pallas_available,
     pallas_works,
 )
+from localai_tpu.ops.pallas.paged_scatter import (  # noqa: F401
+    paged_scatter_append,
+    paged_scatter_append_q8,
+)

@@ -39,6 +39,10 @@ from jax.experimental.pallas import tpu as pltpu
 # row's first blocks are fully masked (sliding window, ragged tails)
 NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
 
+# jax < 0.5 spells it TPUCompilerParams; 0.5+ renamed it CompilerParams
+CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams")
+
 
 def pallas_available() -> bool:
     return jax.default_backend() == "tpu"
@@ -143,7 +147,7 @@ def flash_prefill(q, k, v, lengths, sliding_window=None,
                                    lambda b, h, qb, lens: (b, h, qb, 0)),
         ),
         out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(lengths.astype(jnp.int32), qt, kt, vt)
@@ -262,7 +266,7 @@ def ragged_decode(q, k_cache, v_cache, lengths, sliding_window=None,
                 ],
             ),
             out_shape=jax.ShapeDtypeStruct(qg.shape, q.dtype),
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=CompilerParams(
                 dimension_semantics=("parallel", "parallel", "arbitrary")),
             interpret=_interpret(),
         )(lengths.astype(jnp.int32), table.astype(jnp.int32), qg,
@@ -302,7 +306,7 @@ def ragged_decode(q, k_cache, v_cache, lengths, sliding_window=None,
             ],
         ),
         out_shape=jax.ShapeDtypeStruct(qg.shape, q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(lengths.astype(jnp.int32), qg, k_cache, v_cache)
@@ -427,7 +431,7 @@ def ragged_decode_q8(q, k_q, k_s, v_q, v_s, lengths, sliding_window=None,
                 ],
             ),
             out_shape=jax.ShapeDtypeStruct(qg.shape, q.dtype),
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=CompilerParams(
                 dimension_semantics=("parallel", "parallel", "arbitrary")),
             interpret=_interpret(),
         )(lengths.astype(jnp.int32), table.astype(jnp.int32), qg,
@@ -472,7 +476,7 @@ def ragged_decode_q8(q, k_q, k_s, v_q, v_s, lengths, sliding_window=None,
             ],
         ),
         out_shape=jax.ShapeDtypeStruct(qg.shape, q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(lengths.astype(jnp.int32), qg, k_q, k_s.astype(jnp.float32),
@@ -512,16 +516,33 @@ def pallas_works(num_heads: int = 4, num_kv_heads: int = 2,
         flash_prefill(q, kv, kv, lengths,
                       sliding_window=sliding_window).block_until_ready()
         qd = jnp.zeros((B, 1, num_heads, head_dim), dtype)
+        # paged pool shapes for the scatter-append probe (ops/pallas/
+        # paged_scatter.py) — the decode hot path's write kernel must lower
+        # on this chip too, or the whole paged tier falls back to XLA
+        from localai_tpu.ops.pallas.paged_scatter import (
+            paged_scatter_append, paged_scatter_append_q8,
+        )
+
+        table = jnp.zeros((B, 2), jnp.int32)
+        pos = jnp.zeros((B,), jnp.int32)
+        knew = jnp.zeros((B, num_kv_heads, head_dim), dtype)
         if kv_quant:
             cq = jnp.zeros((B, num_kv_heads, T, head_dim), jnp.int8)
             cs = jnp.zeros((B, num_kv_heads, T // 128, 128), jnp.float32)
             ragged_decode_q8(
                 qd, cq, cs, cq, cs, lengths,
                 sliding_window=sliding_window).block_until_ready()
+            pq = jnp.zeros((2, num_kv_heads, 128, head_dim), jnp.int8)
+            ps = jnp.zeros((2, num_kv_heads, 1, 128), jnp.float32)
+            jax.block_until_ready(paged_scatter_append_q8(
+                pq, ps, pq, ps, knew, knew, pos, table))
         else:
             cache = jnp.zeros((B, num_kv_heads, T, head_dim), dtype)
             ragged_decode(qd, cache, cache, lengths,
                           sliding_window=sliding_window).block_until_ready()
+            pool = jnp.zeros((2, num_kv_heads, 128, head_dim), dtype)
+            jax.block_until_ready(paged_scatter_append(
+                pool, pool, knew, knew, pos, table))
 
     # _attn_impls consults this probe at TRACE time (inside jit). JAX's trace
     # stack is thread-local, so a worker thread compiles + runs the probe

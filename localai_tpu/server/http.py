@@ -385,6 +385,10 @@ class API:
         if cfg.template.use_tokenizer_template or not cfg.template.chat:
             opts["messages_json"] = json.dumps(messages)
             opts["use_tokenizer_template"] = True
+            if body.get("tools"):
+                # the backend renders these into the prompt through the
+                # tokenizer chat template's `tools` variable
+                opts["tools_json"] = json.dumps(body["tools"])
         else:
             from localai_tpu.templates import evaluate_chat
 

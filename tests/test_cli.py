@@ -47,6 +47,19 @@ def test_cli_tts_writes_wav(tmp_path, monkeypatch):
         assert w.getnframes() > 1000
 
 
+def test_cli_soundgeneration_writes_wav(tmp_path, monkeypatch):
+    """`soundgeneration` wraps the existing SoundGeneration RPC (reference
+    core/cli/soundgeneration.go; VERDICT Missing #7)."""
+    monkeypatch.setenv("LOCALAI_JAX_PLATFORM", "cpu")
+    out = tmp_path / "rain.wav"
+    rc = main(["soundgeneration", "rain on a tin roof", "--duration", "1.0",
+               "--output-file", str(out), "--models-path", str(tmp_path)])
+    assert rc == 0
+    with wave.open(str(out)) as w:
+        assert w.getframerate() == 16000
+        assert w.getnframes() >= 16000  # >= the requested 1 s
+
+
 def test_cli_transcript_formats(tmp_path, monkeypatch, whisper_models_dir,
                                 capsys):
     monkeypatch.setenv("LOCALAI_JAX_PLATFORM", "cpu")

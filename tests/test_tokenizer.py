@@ -27,6 +27,41 @@ def _metaspace_tokenizer():
     return Tokenizer(tok, bos_id=1, eos_ids={2})
 
 
+def test_encode_chat_renders_tools_and_llm_passes_them():
+    """Tool schemas must reach the model's prompt: encode_chat threads
+    `tools` into the chat template, and the llm backend's prompt builder
+    forwards PredictOptions.tools_json to it (VERDICT Missing #1 — the
+    grammar constrained the OUTPUT while the model never saw the tools)."""
+    import json
+    from types import SimpleNamespace
+
+    tok = _metaspace_tokenizer()
+    tok.chat_template = (
+        "{% for message in messages %}{{ message['content'] }} "
+        "{% endfor %}"
+        "{% if tools %}tools: {% for t in tools %}"
+        "{{ t['function']['name'] }} {% endfor %}{% endif %}")
+    tools = [{"type": "function",
+              "function": {"name": "box fox", "parameters": {}}}]
+    messages = [{"role": "user", "content": "hello world"}]
+    with_tools = tok.encode_chat(messages, tools=tools)
+    without = tok.encode_chat(messages)
+    assert with_tools != without
+    assert "box fox" in tok.decode(with_tools)
+
+    # the servicer's prompt builder: tools_json → encode_chat(tools=...)
+    from localai_tpu.backend.llm import LLMServicer
+
+    svc = LLMServicer()
+    svc.tok = tok
+    req = SimpleNamespace(prompt_ids=[], use_tokenizer_template=True,
+                          messages_json=json.dumps(messages),
+                          tools_json=json.dumps(tools), prompt="")
+    assert svc._prompt_ids(req, context=None) == with_tools
+    req.tools_json = ""
+    assert svc._prompt_ids(req, context=None) == without
+
+
 def test_metaspace_streaming_keeps_spaces():
     tok = _metaspace_tokenizer()
     s = "hello world this is the quick fox"
