@@ -27,6 +27,9 @@ import threading
 import time
 
 
+RUNS_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "bench_runs")
+
 SIZES = {
     # geometry dicts are HF config.json bodies (synthetic checkpoints)
     "tiny": dict(vocab_size=512, hidden_size=128, intermediate_size=256,
@@ -53,6 +56,78 @@ SIZES = {
 
 def note(msg):
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+# ---------------------------------------------------------- run artifacts
+# The scoreboard contract (ROADMAP open item #1 / VERDICT round-5 ask #1):
+# BENCH_rN.json must never print `device: cpu` while a real on-chip artifact
+# exists. Every on-accelerator run is archived under bench_runs/; when the
+# TPU probe fails or finds only CPU, the freshest archived TPU artifact is
+# re-emitted with `stale: true` and its original timestamp instead of a
+# non-comparable CPU number.
+
+def _is_tpu_device(device) -> bool:
+    d = str(device or "").lower()
+    return bool(d) and "cpu" not in d
+
+
+def save_artifact(result: dict, runs_dir: str = "") -> str | None:
+    """Archive an on-accelerator result JSON under bench_runs/ (no-op for
+    CPU results — only real chip numbers feed the stale fallback)."""
+    if not _is_tpu_device(result.get("device")):
+        return None
+    runs_dir = runs_dir or os.environ.get("BENCH_RUNS_DIR", RUNS_DIR)
+    try:
+        os.makedirs(runs_dir, exist_ok=True)
+        art = dict(result, recorded_at=time.strftime(
+            "%Y-%m-%dT%H:%M:%S%z", time.localtime()))
+        path = os.path.join(
+            runs_dir, f"bench_{time.strftime('%Y%m%d_%H%M%S')}.json")
+        with open(path, "w") as fh:
+            json.dump(art, fh, indent=1)
+        note(f"archived artifact -> {path}")
+        return path
+    except OSError as e:
+        note(f"artifact archive failed ({e}) — result still printed")
+        return None
+
+
+def latest_tpu_artifact(runs_dir: str = "") -> tuple[dict, str] | None:
+    """Newest archived artifact whose device is a real accelerator, or None.
+    Ordering: the `recorded_at` stamp when present, file mtime otherwise."""
+    runs_dir = runs_dir or os.environ.get("BENCH_RUNS_DIR", RUNS_DIR)
+    best = None
+    if not os.path.isdir(runs_dir):
+        return None
+    for fname in os.listdir(runs_dir):
+        if not fname.endswith(".json"):
+            continue
+        path = os.path.join(runs_dir, fname)
+        try:
+            with open(path) as fh:
+                data = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(data, dict) or not _is_tpu_device(
+                data.get("device")):
+            continue
+        key = (data.get("recorded_at") or "", os.path.getmtime(path))
+        if best is None or key > best[0]:
+            best = (key, data, path)
+    return (best[1], best[2]) if best else None
+
+
+def emit_stale_artifact(art: dict, path: str, probe_error: str) -> None:
+    """Print the archived on-chip result as THE scoreboard line, flagged
+    stale — never a CPU number when a real TPU artifact exists."""
+    out = dict(art)
+    out["stale"] = True
+    out["stale_source"] = os.path.basename(path)
+    if probe_error:
+        out["probe_error"] = probe_error[:500]
+    note(f"TPU unreachable — surfacing stale on-chip artifact "
+         f"{out['stale_source']} (recorded {out.get('recorded_at', '?')})")
+    print(json.dumps(out))
 
 
 def write_synthetic_checkpoint(size: str, path: str) -> str:
@@ -276,6 +351,11 @@ def bench_serve(args, size: str, on_cpu: bool):
                  f"{m.get('admit_dispatches', 0):.0f} admit dispatches")
         except Exception:
             pass
+        if getattr(args, "trace", False):
+            try:   # pull spans + stage profile before the backend dies
+                args.trace_payload = handle.client.trace()
+            except Exception as e:
+                note(f"trace fetch failed: {e}")
         return statistics.median(tput), ttft_ms, context, dtype
     finally:
         manager.stop_all()
@@ -370,6 +450,14 @@ def bench_engine(args, size: str, on_cpu: bool, kv_pages: int | None = None):
          f"{m['decode_steps_dispatched']} steps "
          f"({m['decode_steps_dispatched'] / d:.1f} steps/dispatch), "
          f"{m['admit_dispatches']} admit dispatches")
+    if getattr(args, "trace", False):
+        from localai_tpu import telemetry
+
+        args.trace_payload = {
+            "spans": telemetry.chrome_events(),
+            "profile": eng._prof.report() if eng._prof is not None else {},
+            "pid": os.getpid(),
+        }
     import shutil
 
     shutil.rmtree(tmp, ignore_errors=True)
@@ -532,7 +620,7 @@ def bench_whisper(args, on_cpu: bool):
     return statistics.median(rtfs)
 
 
-def main(argv=None):
+def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser()
     p.add_argument("--size", default=None,
                    help="tiny|1b|3b|8b (default: 8b on TPU, tiny on CPU)")
@@ -557,9 +645,77 @@ def main(argv=None):
                    help="paged KV pool size in 128-token blocks "
                         "(0 = dense per-slot cache); lets slot count "
                         "oversubscribe context at ctx 8192")
-    args = p.parse_args(argv)
+    p.add_argument("--trace", action="store_true",
+                   help="telemetry run: record spans + fenced stage timings "
+                        "(LOCALAI_TRACE/LOCALAI_PROFILE), write a "
+                        "Chrome-trace dump and add a per-stage breakdown "
+                        "to the result JSON")
+    p.add_argument("--trace-out", default="bench_trace.json",
+                   help="Chrome-trace output path for --trace")
+    p.add_argument("--runs-dir", default=None,
+                   help="artifact archive dir (default bench_runs/ next to "
+                        "bench.py, or $BENCH_RUNS_DIR)")
+    p.add_argument("--allow-cpu-fallback", action="store_true",
+                   help="emit the CPU smoke number even when an archived "
+                        "on-chip artifact exists (default: surface the "
+                        "stale TPU artifact instead)")
+    return p
+
+
+def emit_result(result: dict, args) -> int:
+    """Final scoreboard emission: fold in the --trace stage breakdown, write
+    the Chrome-trace dump, archive on-chip artifacts, print the JSON line."""
+    payload = getattr(args, "trace_payload", None)
+    if payload is not None:
+        profile = payload.get("profile") or {}
+        stages = profile.get("stages") or {}
+        if stages:
+            result["stages"] = {
+                name: dict(
+                    share=round(st["share"], 4),
+                    total_ms=round(st["total_ms"], 2),
+                    p50_ms=round(st["p50_ms"], 3),
+                    count=st["count"],
+                    tok_s=round(st["tok_s"], 1),
+                    **({"mfu": round(st["mfu"], 4)} if st.get("mfu") else {}))
+                for name, st in stages.items()}
+            result["stage_coverage"] = round(profile.get("coverage", 0.0), 4)
+        try:
+            from localai_tpu import telemetry
+
+            # backend spans + this (parent) process's rpc/client spans
+            events = list(payload.get("spans") or [])
+            events += telemetry.chrome_events()
+            events.sort(key=lambda e: e.get("ts", 0))
+            names = {os.getpid(): "bench"}
+            if payload.get("pid"):
+                names[payload["pid"]] = "backend"
+            with open(args.trace_out, "w") as fh:
+                json.dump(telemetry.chrome_trace(events, names), fh)
+            note(f"chrome trace ({len(events)} events) -> {args.trace_out}")
+        except Exception as e:
+            note(f"trace dump failed: {e}")
+    save_artifact(result, args.runs_dir or "")
+    print(json.dumps(result))
+    return 0
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    if args.trace:
+        # env, not in-process flags: serve mode's backend subprocess must
+        # inherit them (manager spawn copies os.environ)
+        os.environ["LOCALAI_TRACE"] = "1"
+        os.environ["LOCALAI_PROFILE"] = "1"
 
     on_cpu, probe_error, device_kind = probe_accelerator(args)
+    if on_cpu and not args.cpu and not args.allow_cpu_fallback:
+        # TPU expected but unreachable: the scoreboard gets the newest
+        # archived on-chip artifact (flagged stale), never a CPU number
+        found = latest_tpu_artifact(args.runs_dir or "")
+        if found is not None:
+            emit_stale_artifact(found[0], found[1], probe_error)
+            return 0
     size = args.size or ("tiny" if on_cpu else "8b")
     if args.slots is None:
         # int8-KV geometries halve per-slot HBM → double the slot count;
@@ -579,8 +735,7 @@ def main(argv=None):
             "vs_baseline": None, "device": device_kind}
         if on_cpu and not args.cpu:
             out["probe_error"] = probe_error[:500]
-        print(json.dumps(out))
-        return 0
+        return emit_result(out, args)
     if args.mode == "whisper":
         rtf = bench_whisper(args, on_cpu)
         geom = "tiny-smoke, 5 s" if on_cpu else "whisper-base, 20 s"
@@ -591,8 +746,7 @@ def main(argv=None):
             "vs_baseline": None, "device": device_kind}
         if on_cpu and not args.cpu:
             out["probe_error"] = probe_error[:500]
-        print(json.dumps(out))
-        return 0
+        return emit_result(out, args)
     if args.mode == "paged":
         import jax
 
@@ -622,8 +776,7 @@ def main(argv=None):
         }
         if on_cpu and not args.cpu:
             result["probe_error"] = probe_error[:500]
-        print(json.dumps(result))
-        return 0
+        return emit_result(result, args)
     if args.mode == "serve":
         # the parent process stays JAX-free: the backend subprocess owns the
         # accelerator, exactly like production serving
@@ -657,8 +810,7 @@ def main(argv=None):
     }
     if on_cpu and not args.cpu:
         result["probe_error"] = probe_error[:500]
-    print(json.dumps(result))
-    return 0
+    return emit_result(result, args)
 
 
 if __name__ == "__main__":

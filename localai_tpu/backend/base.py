@@ -28,6 +28,21 @@ class BackendServicer:
     def Health(self, request, context):
         return pb.Reply(message=b"OK")
 
+    def GetTrace(self, request, context):
+        """Telemetry export (every role): this process's recorded spans as
+        Chrome-trace events in Reply.message JSON. Roles with a device-step
+        profiler (llm) override to add the stage breakdown."""
+        import json
+        import os
+
+        from localai_tpu import telemetry
+
+        return pb.Reply(message=json.dumps({
+            "spans": telemetry.chrome_events(),
+            "profile": {},
+            "pid": os.getpid(),
+        }).encode())
+
 
 for _m in pb.SERVICE.methods:
     if not hasattr(BackendServicer, _m.name):

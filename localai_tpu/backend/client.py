@@ -7,12 +7,25 @@ proto DESCRIPTOR, same wire format.
 """
 from __future__ import annotations
 
+import json
 import time
 from typing import Iterator
 
 import grpc
 
+from localai_tpu import telemetry
 from localai_tpu.backend import pb
+
+# gRPC metadata key carrying the HTTP request id into the backend process
+# (server/http.py middleware → here → backend/llm.py → GenRequest.trace_id)
+REQUEST_ID_KEY = "x-localai-request-id"
+
+
+def _trace_md():
+    """Metadata tuple propagating the current context's request id (None
+    when no request id is bound — the common non-traced path)."""
+    rid = telemetry.current_request_id()
+    return ((REQUEST_ID_KEY, rid),) if rid else None
 
 
 class BackendClient:
@@ -80,14 +93,26 @@ class BackendClient:
         return self._calls["LoadModel"](pb.ModelOptions(**kw), timeout=timeout)
 
     def predict(self, timeout: float = 600.0, **kw) -> "pb.Reply":
-        return self._calls["Predict"](pb.PredictOptions(**kw), timeout=timeout)
+        with telemetry.span("rpc.Predict", cat="rpc", addr=self.addr):
+            return self._calls["Predict"](pb.PredictOptions(**kw),
+                                          timeout=timeout,
+                                          metadata=_trace_md())
 
     def predict_stream(self, timeout: float = 600.0, **kw) -> Iterator["pb.Reply"]:
-        return self._calls["PredictStream"](pb.PredictOptions(**kw),
-                                            timeout=timeout)
+        # the span covers only the stream OPEN — iteration happens on the
+        # caller's pump thread; the backend-side grpc.PredictStream span
+        # carries the full generation interval
+        with telemetry.span("rpc.PredictStream.open", cat="rpc",
+                            addr=self.addr):
+            return self._calls["PredictStream"](pb.PredictOptions(**kw),
+                                                timeout=timeout,
+                                                metadata=_trace_md())
 
     def embedding(self, timeout: float = 600.0, **kw) -> "pb.EmbeddingResult":
-        return self._calls["Embedding"](pb.PredictOptions(**kw), timeout=timeout)
+        with telemetry.span("rpc.Embedding", cat="rpc", addr=self.addr):
+            return self._calls["Embedding"](pb.PredictOptions(**kw),
+                                            timeout=timeout,
+                                            metadata=_trace_md())
 
     def tokenize(self, prompt: str, timeout: float = 60.0) -> "pb.TokenizationResponse":
         return self._calls["TokenizeString"](pb.PredictOptions(prompt=prompt),
@@ -102,6 +127,12 @@ class BackendClient:
     def metrics(self, timeout: float = 10.0) -> dict:
         r = self._calls["GetMetrics"](pb.MetricsRequest(), timeout=timeout)
         return dict(r.metrics)
+
+    def trace(self, timeout: float = 30.0) -> dict:
+        """Backend telemetry snapshot: {"spans": [chrome events],
+        "profile": {stage breakdown}, "pid": N} (GetTrace RPC)."""
+        r = self._calls["GetTrace"](pb.MetricsRequest(), timeout=timeout)
+        return json.loads(r.message.decode() or "{}")
 
     def tts(self, timeout: float = 600.0, **kw) -> "pb.Result":
         return self._calls["TTS"](pb.TTSRequest(**kw), timeout=timeout)

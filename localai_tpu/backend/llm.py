@@ -14,9 +14,23 @@ import time
 
 import grpc
 
+from localai_tpu import telemetry
 from localai_tpu.backend import pb
 from localai_tpu.backend.base import BackendServicer
+from localai_tpu.backend.client import REQUEST_ID_KEY
 from localai_tpu.ops.sampling import SamplingParams
+
+
+def _request_id(context) -> str:
+    """The HTTP layer's request id, if the client attached one (metadata
+    propagation — backend/client.py _trace_md)."""
+    try:
+        for k, v in context.invocation_metadata():
+            if k == REQUEST_ID_KEY:
+                return v
+    except Exception:
+        pass
+    return ""
 
 
 class LLMServicer(BackendServicer):
@@ -295,7 +309,8 @@ class LLMServicer(BackendServicer):
             logit_bias=dict(request.logit_bias) or None,
         )
 
-    def _submit(self, request, context):
+    def _submit(self, request, context, trace_id: str = "",
+                trace_parent: int = 0):
         from localai_tpu.engine import GenRequest
 
         ids = self._prompt_ids(request, context)
@@ -326,6 +341,8 @@ class LLMServicer(BackendServicer):
             prompt_cache_ro=request.prompt_cache_ro,
             mm_embeds=mm_embeds,
             mm_positions=mm_positions,
+            trace_id=trace_id,
+            trace_parent=trace_parent,
         )
         try:
             return self.engine.submit(req)
@@ -363,7 +380,12 @@ class LLMServicer(BackendServicer):
     def Predict(self, request, context):
         self._require_engine(context)
         t0 = time.monotonic()
-        rid, out = self._submit(request, context)
+        trace_id = _request_id(context)
+        tr = telemetry.maybe_tracer()
+        gspan = tr.begin("grpc.Predict", cat="grpc",
+                         args={"request_id": trace_id}) if tr else None
+        rid, out = self._submit(request, context, trace_id=trace_id,
+                                trace_parent=gspan.sid if gspan else 0)
         text, ids, logprobs, ttft = [], [], [], 0.0
         o = None
         while True:
@@ -377,6 +399,8 @@ class LLMServicer(BackendServicer):
                 logprobs.append(o.logprob)
             if o.finished:
                 break
+        if gspan is not None:
+            tr.finish(gspan, tokens=o.generated_tokens, ttft_s=ttft)
         return pb.Reply(
             message="".join(text).encode(),
             tokens=o.generated_tokens,
@@ -391,7 +415,12 @@ class LLMServicer(BackendServicer):
     def PredictStream(self, request, context):
         self._require_engine(context)
         t0 = time.monotonic()
-        rid, out = self._submit(request, context)
+        trace_id = _request_id(context)
+        tr = telemetry.maybe_tracer()
+        gspan = tr.begin("grpc.PredictStream", cat="grpc",
+                         args={"request_id": trace_id}) if tr else None
+        rid, out = self._submit(request, context, trace_id=trace_id,
+                                trace_parent=gspan.sid if gspan else 0)
         ttft = 0.0
         while True:
             o = out.get()
@@ -409,6 +438,8 @@ class LLMServicer(BackendServicer):
                 finish_reason=o.finish_reason or "",
             )
             if o.finished:
+                if gspan is not None:
+                    tr.finish(gspan, tokens=o.generated_tokens, ttft_s=ttft)
                 return
 
     # ------------------------------------------------------------ aux RPCs
@@ -481,7 +512,22 @@ class LLMServicer(BackendServicer):
 
     def GetMetrics(self, request, context):
         m = dict(self.engine.metrics) if self.engine else {}
+        if self.engine is not None and self.engine._prof is not None:
+            # flattened stage profile (prof_<stage>_{count,total_ms,p50_ms,
+            # tok_s}) rides the existing str→double metrics surface
+            m.update(self.engine._prof.flat())
         return pb.MetricsResponse(metrics={k: float(v) for k, v in m.items()})
+
+    def GetTrace(self, request, context):
+        payload = {
+            "spans": telemetry.chrome_events(),
+            "profile": (self.engine._prof.report()
+                        if self.engine is not None
+                        and self.engine._prof is not None else {}),
+            "pid": os.getpid(),
+            "model": self.model_name,
+        }
+        return pb.Reply(message=json.dumps(payload).encode())
 
     def shutdown(self):
         if self.engine is not None:

@@ -34,6 +34,12 @@ def _add_run(sub):
                    help=".env file to load (default: ./.env, ./.env.local)")
     p.add_argument("--disable-config-watcher", action="store_true",
                    help="do not hot-reload model YAMLs on change")
+    p.add_argument("--trace", action="store_true",
+                   help="record request/engine spans (LOCALAI_TRACE=1); "
+                        "export via /debug/trace or `util trace`")
+    p.add_argument("--profile", action="store_true",
+                   help="fenced device-step stage timing (LOCALAI_PROFILE=1;"
+                        " measurement mode — serializes the decode pipeline)")
     p.add_argument("--log-level", default="info")
     return p
 
@@ -207,20 +213,74 @@ def _add_worker(sub):
 def _add_util(sub):
     p = sub.add_parser("util",
                        help="model utilities (reference: core/cli util cmd)")
-    p.add_argument("action", choices=["hf-info", "fits"],
+    p.add_argument("action", choices=["hf-info", "fits", "trace"],
                    help="hf-info: checkpoint geometry + params; "
-                            "fits: HBM fit estimate")
-    p.add_argument("model", help="checkpoint directory")
+                            "fits: HBM fit estimate; "
+                            "trace: pull a Chrome-trace + stage profile "
+                            "from a running server's /debug endpoints")
+    p.add_argument("model", help="checkpoint directory (hf-info/fits) or "
+                                 "server address (trace)")
     p.add_argument("--slots", type=int, default=8)
     p.add_argument("--context", type=int, default=2048)
     p.add_argument("--dtype", default="bfloat16")
     p.add_argument("--cache-type", default="")
     p.add_argument("--hbm-gb", type=float, default=None)
+    p.add_argument("--out", default="trace.json",
+                   help="trace: output Chrome-trace file")
+    p.add_argument("--api-key", default="",
+                   help="trace: bearer token for a key-protected server")
     return p
+
+
+def cli_util_trace(args) -> int:
+    """`local-ai util trace <addr>` — fetch /debug/trace into a Chrome-trace
+    file (open at chrome://tracing) and print the /debug/profile stage
+    breakdown. The server must run with --trace (and --profile for stages)."""
+    import json as _json
+    import urllib.request
+
+    base = args.model if args.model.startswith("http") \
+        else f"http://{args.model}"
+
+    def fetch(path):
+        req = urllib.request.Request(base + path)
+        if args.api_key:
+            req.add_header("Authorization", f"Bearer {args.api_key}")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return _json.loads(r.read().decode())
+
+    trace = fetch("/debug/trace")
+    with open(args.out, "w") as fh:
+        _json.dump(trace, fh)
+    n = len(trace.get("traceEvents", []))
+    print(f"{args.out}: {n} events")
+    profile = fetch("/debug/profile")
+    for model, prof in (profile.get("models") or {}).items():
+        stages = (prof or {}).get("stages") or {}
+        if not stages:
+            continue
+        print(f"\n{model}: coverage {prof.get('coverage', 0):.0%} of "
+              f"{prof.get('wall_ms', 0):.0f} ms busy window")
+        width = max(len(s) for s in stages)
+        for name, st in sorted(stages.items(),
+                               key=lambda kv: -kv[1]["total_ms"]):
+            mfu = f" mfu {st['mfu']:.1%}" if st.get("mfu") else ""
+            print(f"  {name:<{width}}  {st['share']:>5.1%}  "
+                  f"{st['total_ms']:>9.1f} ms  x{st['count']:<6d} "
+                  f"p50 {st['p50_ms']:.2f} ms  "
+                  f"{st['tok_s']:.0f} tok/s{mfu}")
+    if not any((p or {}).get("stages")
+               for p in (profile.get("models") or {}).values()):
+        print("no stage profile (run the server with --profile / "
+              "LOCALAI_PROFILE=1)")
+    return 0
 
 
 def cli_util(args) -> int:
     import json as _json
+
+    if args.action == "trace":
+        return cli_util_trace(args)
 
     from localai_tpu.engine.loader import load_config
     from localai_tpu.system.memory import estimate, param_count

@@ -9,11 +9,14 @@ under `parameters:` exactly like the reference. Multi-model single files
 from __future__ import annotations
 
 import dataclasses
+import logging
 import os
 import threading
 from typing import Any
 
 import yaml
+
+log = logging.getLogger("localai_tpu")
 
 
 @dataclasses.dataclass
@@ -51,6 +54,25 @@ class TemplateConfig:
     completion: str = ""
     edit: str = ""
     use_tokenizer_template: bool = True
+
+
+# Reference template fields this port intentionally does NOT render: tool
+# schemas and multimodal markers go through the tokenizer chat template
+# instead, and reply_prefix is never applied. A YAML using them must say so
+# out loud (VERDICT Weak #8) — silent dropping made ported configs
+# misbehave invisibly. key → what actually happens here.
+_UNSUPPORTED_TEMPLATE_FIELDS = {
+    "function": "tool schemas render via the tokenizer chat template's "
+                "`tools` variable, not a Go template",
+    "functions": "tool schemas render via the tokenizer chat template's "
+                 "`tools` variable, not a Go template",
+    "multimodal": "image placeholders expand engine-side "
+                  "(<image> markers), not via a template",
+    "reply_prefix": "reply prefixes are not applied",
+    "join_chat_messages_by_character": "message joining is fixed to newline",
+    "jinja_template": "the HF tokenizer's own chat template is used; "
+                      "set use_tokenizer_template instead",
+}
 
 
 @dataclasses.dataclass
@@ -99,6 +121,10 @@ class ModelConfig:
                                      # agent loop knobs {max_iterations: N}
     pipeline: Pipeline = dataclasses.field(default_factory=Pipeline)
     known_usecases: list[str] = dataclasses.field(default_factory=list)
+    # reference template fields the YAML used but this port ignores
+    # (populated by from_dict; the loader logs one structured warning)
+    unsupported_template_fields: list[str] = dataclasses.field(
+        default_factory=list)
     # file this config came from (set by the loader)
     config_file: str = ""
 
@@ -120,6 +146,15 @@ class ModelConfig:
             k: v for k, v in tmpl.items()
             if k in {f.name for f in dataclasses.fields(TemplateConfig)}
         })
+        cfg.unsupported_template_fields = sorted(
+            k for k, v in tmpl.items()
+            if k in _UNSUPPORTED_TEMPLATE_FIELDS and v not in (None, "", {}))
+        if cfg.unsupported_template_fields:
+            log.warning(
+                "model %r: unsupported template field(s) ignored: %s",
+                cfg.name or "<unnamed>",
+                "; ".join(f"{k} ({_UNSUPPORTED_TEMPLATE_FIELDS[k]})"
+                          for k in cfg.unsupported_template_fields))
         cfg.mesh = MeshShape(**{k: v for k, v in mesh.items()
                                 if k in ("data", "model")})
         return cfg

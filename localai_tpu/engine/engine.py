@@ -120,6 +120,9 @@ class GenRequest:
                                   # (reference PromptCachePath,
                                   # backend.proto:136-142)
     prompt_cache_ro: bool = False  # reuse only; never rewrite the file
+    trace_id: str = ""            # request id propagated from the HTTP layer
+                                  # (telemetry span correlation; "" = untraced)
+    trace_parent: int = 0         # parent span id (the gRPC handler's span)
     # multimodal (models/llava.py): projected image features [K, H] f32 and
     # the prompt positions they occupy (the expanded image-token slots) —
     # injected into prefill instead of token embeddings
@@ -163,6 +166,8 @@ class _Slot:
     fast_w: int | None = None        # narrowest sort-free top-k width that
                                      # covers this slot's sampling (None =
                                      # needs the full-sort path)
+    span: Any = None                 # open telemetry span for this request
+                                     # (None when tracing is disabled)
 
 
 class Engine:
@@ -284,6 +289,14 @@ class Engine:
         if self._draft is not None:
             self.metrics["draft_proposed"] = 0
             self.metrics["draft_accepted"] = 0
+
+        # telemetry (localai_tpu/telemetry): both gates resolve to None/False
+        # here so the per-dispatch cost of a disabled build is one attribute
+        # load + branch (see _obs) — the hot path stays fence-free
+        from localai_tpu import telemetry
+
+        self._prof = telemetry.engine_profiler(cfg)
+        self._tracer = telemetry.maybe_tracer()
 
         self._build_jit()
 
@@ -612,6 +625,27 @@ class Engine:
         the single source of truth with no donation bookkeeping."""
         return jnp.asarray(self._table) if self._paged else None
 
+    def _obs(self, stage: str, t0: float, tokens: int = 0, fence=None,
+             **args):
+        """Record one device-dispatch observation (telemetry subsystem).
+
+        With LOCALAI_PROFILE the profiler fences (`block_until_ready`) before
+        reading the clock, so the sample is the stage's real host+device cost
+        — opt-in because the fence defeats the decode pipeline. With
+        LOCALAI_TRACE a span lands in the ring buffer (un-fenced samples
+        measure enqueue time only and say so via the `fenced` arg). Disabled
+        (the default) this is two attribute loads and a branch."""
+        prof, tr = self._prof, self._tracer
+        if prof is None and tr is None:
+            return
+        dur = None
+        if prof is not None:
+            dur = prof.record(stage, t0, tokens=tokens, fence=fence)
+        if tr is not None:
+            tr.add_complete("engine." + stage, t0, dur_s=dur, cat="engine",
+                            args=dict(args, tokens=tokens,
+                                      fenced=prof is not None))
+
     def _dev_admit(self, ids, n, slot, row, counts_row, inject=None):
         # single admission == the K=1 batched case (the delegate broadcasts
         # "admit_many"; the "admit" follower op is kept for replay compat)
@@ -625,6 +659,7 @@ class Engine:
     def _dev_admit_many(self, ids, lens, slots, rows, counts_rows,
                         inject=None):
         self.metrics["admit_dispatches"] += 1
+        t0 = time.perf_counter()
         self._bcast("admit_many", ids=ids, lens=lens, slots=slots,
                     rows={k: np.asarray(v) for k, v in rows.items()},
                     counts_rows=counts_rows, inject=self._inj_msg(inject))
@@ -638,6 +673,8 @@ class Engine:
                 {k: jnp.asarray(v) for k, v in rows.items()},
                 None if counts_rows is None else jnp.asarray(counts_rows),
                 self._tab(), self._inj(inject))
+        self._obs("admit", t0, tokens=int(np.sum(lens)),
+                  fence=self._lengths, requests=len(slots))
 
     @staticmethod
     def _inj(inject):
@@ -664,6 +701,7 @@ class Engine:
         return (msg["extra"], msg["mask"])
 
     def _dev_extend_mid(self, buf, pos, idx, inject=None):
+        t0 = time.perf_counter()
         self._bcast("extend_mid", buf=buf, pos=pos, idx=idx,
                     inject=self._inj_msg(inject))
         with activate_mesh(self.mesh):
@@ -671,9 +709,12 @@ class Engine:
                 self.params, self._cos, self._sin, self._kc, self._vc,
                 jnp.asarray(buf), jnp.int32(pos), jnp.int32(idx), self._tab(),
                 self._inj(inject))
+        self._obs("prefill", t0, tokens=int(buf.shape[1]), fence=self._kc,
+                  slot=int(idx), final=False)
 
     def _dev_extend_final(self, buf, pos, nvalid, idx, row, counts_row,
                           inject=None):
+        t0 = time.perf_counter()
         self._bcast("extend_final", buf=buf, pos=pos, nvalid=nvalid, idx=idx,
                     row={k: np.asarray(v) for k, v in row.items()},
                     counts_row=counts_row, inject=self._inj_msg(inject))
@@ -687,10 +728,13 @@ class Engine:
                 {k: jnp.asarray(v) for k, v in row.items()},
                 None if counts_row is None else jnp.asarray(counts_row),
                 self._tab(), self._inj(inject))
+        self._obs("prefill", t0, tokens=int(nvalid), fence=self._lengths,
+                  slot=int(idx), final=True)
 
     def _dev_decode(self, active, mask_host=None, fast_width=None):
         self.metrics["decode_dispatches"] += 1
         self.metrics["decode_steps_dispatched"] += 1
+        t0 = time.perf_counter()
         self._bcast("decode", active=active,
                     mask=None if mask_host is None else mask_host,
                     fast_width=fast_width)
@@ -710,12 +754,16 @@ class Engine:
                 (tokens, logprobs, self._kc, self._vc, self._sampler,
                  self._last_logits, self._lengths) = self._decode_nomask_fn(
                     *args, table=self._tab())
+        self._obs("decode", t0, tokens=int(np.sum(active)), fence=tokens,
+                  fast_width=fast_width or 0,
+                  grammar=mask_host is not None)
         return tokens, logprobs
 
     def _dev_decode_block(self, active, steps: int, fast_width=None,
                           mask_host=None):
         self.metrics["decode_dispatches"] += 1
         self.metrics["decode_steps_dispatched"] += steps
+        t0 = time.perf_counter()
         self._bcast("decode_block", active=active, steps=steps,
                     fast_width=fast_width,
                     mask=None if mask_host is None else mask_host)
@@ -733,9 +781,13 @@ class Engine:
                  self._last_logits, self._lengths) = self._decode_block_fn(
                     *args, table=self._tab(), steps=steps,
                     fast_width=fast_width)
+        self._obs("decode_block", t0, tokens=steps * int(np.sum(active)),
+                  fence=tokens, steps=steps, fast_width=fast_width or 0,
+                  grammar=mask_host is not None)
         return tokens, logprobs
 
     def _dev_shift(self, idx):
+        t0 = time.perf_counter()
         self._bcast("shift", idx=idx)
         with activate_mesh(self.mesh):
             if self._paged:
@@ -757,6 +809,7 @@ class Engine:
             else:
                 self._kc, self._vc, self._lengths = self._shift_fn(
                     self._kc, self._vc, self._lengths, jnp.int32(idx))
+        self._obs("shift", t0, fence=self._lengths, slot=int(idx))
 
     def _dev_draft_ingest(self, buf, pos, idx):
         self._bcast("draft_ingest", buf=buf, pos=pos, idx=idx)
@@ -777,6 +830,7 @@ class Engine:
         self.metrics["decode_dispatches"] += 1
         # one spec dispatch fuses gamma draft steps + the verify pass
         self.metrics["decode_steps_dispatched"] += self.ec.gamma + 1
+        t0 = time.perf_counter()
         self._bcast("spec", active=active)
         with activate_mesh(self.mesh):
             (tokens_out, n_out, logprobs_out, self._next_tokens,
@@ -786,6 +840,9 @@ class Engine:
                 self._cos_d, self._sin_d, self._kc, self._vc,
                 self._kcd, self._vcd, self._sampler, self._lengths,
                 self._next_tokens, jnp.asarray(active), self._tab())
+        self._obs("spec_decode", t0,
+                  tokens=(self.ec.gamma + 1) * int(np.sum(active)),
+                  fence=tokens_out)
         return tokens_out, n_out, logprobs_out, n_extra
 
     def follow(self, channel) -> None:
@@ -1053,6 +1110,15 @@ class Engine:
             prefilled=not chunked, row=row, counts_row=counts_row,
             prefill_pos=lcp, disk_prefix=disk_prefix, fast_w=fast_w,
         )
+        if self._tracer is not None:
+            # one span per request, admission → release; request_id ties it
+            # to the HTTP/gRPC spans of the same request, trace_parent nests
+            # it under the gRPC handler's span in the merged trace
+            slot_obj.span = self._tracer.begin(
+                "engine.request", cat="engine",
+                parent_id=req.trace_parent or None,
+                args={"request_id": req.trace_id or f"rid-{rid}",
+                      "slot": slot, "prompt_tokens": n})
         self._slots[slot] = slot_obj
         if chunked:
             self._prefillq.append(slot)
@@ -1283,6 +1349,7 @@ class Engine:
         marks that slot for rollback — its accepted prefix stands, the rest of
         its block is discarded, and _repair restores the device state."""
         tokens, logprobs, entries, gmask = pend
+        t0 = time.perf_counter()
         tokens = np.asarray(jax.device_get(tokens))
         logprobs = np.asarray(jax.device_get(logprobs))
         now = time.monotonic()
@@ -1315,6 +1382,11 @@ class Engine:
             slot = self._slots[i]
             if slot is not None:
                 self._repair(i, slot)
+        # "sample" = the host side of sampling: result sync (device_get of
+        # the sampled tokens — the per-step host↔device boundary) plus token
+        # commit (grammar advance, detok, stop scan, stream fan-out)
+        self._obs("sample", t0, tokens=steps * len(entries),
+                  steps=steps, rollbacks=len(rolled))
 
     def _repair(self, idx: int, slot: _Slot):
         """Roll a grammar slot back to its last PDA-accepted token after a
@@ -1813,6 +1885,12 @@ class Engine:
                 slot.req.prompt_cache_path, exc_info=True)
 
     def _release_slot(self, idx: int, slot: _Slot):
+        if slot.span is not None and self._tracer is not None:
+            ttft_ms = ((slot.first_token_time - slot.start_time) * 1e3
+                       if slot.first_token_time is not None else None)
+            self._tracer.finish(slot.span, generated=slot.generated,
+                                ttft_ms=ttft_ms)
+            slot.span = None
         self._save_prompt_cache(idx, slot)
         if slot.matcher is not None:
             self._mask_host[idx] = 0xFF

@@ -6,7 +6,9 @@ from localai_tpu.functions.grammars import (  # noqa: F401
     JSON_GRAMMAR,
 )
 from localai_tpu.functions.tools import (  # noqa: F401
+    NO_ACTION_NAME,
     grammar_for_request,
     parse_tool_calls,
+    parse_tool_response,
     tools_schema,
 )

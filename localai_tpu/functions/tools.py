@@ -11,9 +11,29 @@ from typing import Any
 from localai_tpu.functions.grammars import JSON_GRAMMAR, json_schema_grammar
 
 
-def tools_schema(tools: list[dict]) -> dict:
+# the reference's no-action function (functions.go GrammarConfig: a grammar
+# that ONLY matches tool calls forces a call even when none applies — the
+# "answer" alternative lets tool_choice:"auto" produce prose instead)
+NO_ACTION_NAME = "answer"
+_NO_ACTION_SCHEMA = {
+    "type": "object",
+    "properties": {
+        "name": {"const": NO_ACTION_NAME},
+        "arguments": {
+            "type": "object",
+            "properties": {"message": {"type": "string"}},
+            "required": ["message"],
+        },
+    },
+    "required": ["name", "arguments"],
+}
+
+
+def tools_schema(tools: list[dict], allow_answer: bool = False) -> dict:
     """Schema matching {"name": <one of the tools>, "arguments": {...}} —
-    the reference's ToJSONStructure shape (functions.go)."""
+    the reference's ToJSONStructure shape (functions.go). With
+    `allow_answer` the no-action {"name": "answer", "arguments":
+    {"message": ...}} alternative joins the oneOf (tool_choice "auto")."""
     alts = []
     for t in tools:
         fn = t.get("function", t)
@@ -25,6 +45,10 @@ def tools_schema(tools: list[dict]) -> dict:
             },
             "required": ["name", "arguments"],
         })
+    if allow_answer and not any(
+            t.get("function", t).get("name") == NO_ACTION_NAME
+            for t in tools):
+        alts.append(_NO_ACTION_SCHEMA)
     if len(alts) == 1:
         return alts[0]
     return {"oneOf": alts}
@@ -49,8 +73,30 @@ def grammar_for_request(body: dict) -> str:
             want = choice.get("function", {}).get("name")
             tools = [t for t in tools
                      if t.get("function", t).get("name") == want] or tools
-        return json_schema_grammar(tools_schema(tools))
+        # OpenAI semantics: absent tool_choice means "auto" — only
+        # "required" (or pinning a specific function) forces a call, so
+        # auto gets the no-action "answer" escape hatch
+        auto = choice in (None, "auto")
+        return json_schema_grammar(tools_schema(tools, allow_answer=auto))
     return ""
+
+
+def parse_tool_response(text: str) -> tuple[list[dict] | None, str | None]:
+    """Grammar output → (tool_calls, answer_text): a no-action "answer"
+    object becomes prose content (its `message`), anything else parses like
+    parse_tool_calls. (None, None) = not a tool JSON at all — callers pass
+    the raw text through (reference parse.go + functions.go no-action)."""
+    calls = parse_tool_calls(text)
+    if calls and len(calls) == 1 \
+            and calls[0]["function"]["name"] == NO_ACTION_NAME:
+        raw = calls[0]["function"]["arguments"]
+        try:
+            args = json.loads(raw) if isinstance(raw, str) else raw
+        except ValueError:
+            args = {}
+        msg = args.get("message", "") if isinstance(args, dict) else ""
+        return None, str(msg)
+    return calls, None
 
 
 def parse_tool_calls(text: str) -> list[dict[str, Any]] | None:

@@ -21,19 +21,31 @@ import time
 
 from aiohttp import web
 
+from localai_tpu import telemetry
 from localai_tpu.config import AppConfig, ModelConfig, ModelConfigLoader
 from localai_tpu.core.manager import ModelManager
 from localai_tpu.server import schema
 
 try:
     from prometheus_client import (
-        CONTENT_TYPE_LATEST, Counter, Histogram, generate_latest,
+        CONTENT_TYPE_LATEST, Counter, Gauge, Histogram, generate_latest,
     )
 
     _API_CALLS = Counter("localai_api_calls_total", "API calls",
                          ["path", "status"])
     _API_LATENCY = Histogram("localai_api_latency_seconds", "API latency",
                              ["path"])
+    # engine-stage series (telemetry subsystem): refreshed from each loaded
+    # backend's GetMetrics prof_* keys at scrape time (LOCALAI_PROFILE runs)
+    _STAGE_SECONDS = Gauge(
+        "localai_engine_stage_seconds_total",
+        "Cumulative fenced time per engine stage", ["model", "stage"])
+    _STAGE_DISPATCHES = Gauge(
+        "localai_engine_stage_dispatches_total",
+        "Cumulative dispatch count per engine stage", ["model", "stage"])
+    _STAGE_TOK_S = Gauge(
+        "localai_engine_stage_tokens_per_second",
+        "Tokens/s through each engine stage", ["model", "stage"])
     _HAVE_PROM = True
 except Exception:  # pragma: no cover - prometheus_client is in the image
     _HAVE_PROM = False
@@ -121,6 +133,10 @@ class API:
         r.add_post("/tts", self._speech)
         r.add_post("/vad", self._vad)
         r.add_post("/sound-generation", self._sound_generation)
+        # telemetry debug surface (ISSUE 2): merged Chrome trace + per-model
+        # stage profile across the HTTP process and every backend subprocess
+        r.add_get("/debug/trace", self._debug_trace)
+        r.add_get("/debug/profile", self._debug_profile)
         r.add_get("/backend/monitor", self._backend_monitor)
         r.add_post("/backend/shutdown", self._backend_shutdown)
         r.add_get("/system", self._system)
@@ -171,6 +187,12 @@ class API:
     async def _middleware(self, request: web.Request, handler):
         t0 = time.perf_counter()
         status = 500
+        # request-id propagation root: honor a caller-supplied X-Request-Id,
+        # mint one otherwise; the contextvar follows this request through the
+        # handler (and asyncio.to_thread copies the context) into the gRPC
+        # client's x-localai-request-id metadata → backend → engine spans
+        rid = request.headers.get("X-Request-Id") or telemetry.new_request_id()
+        rid_token = telemetry.set_request_id(rid)
         try:
             if self.cfg.api_keys and request.path not in _OPEN_PATHS:
                 auth = request.headers.get("Authorization", "")
@@ -186,16 +208,25 @@ class API:
             status = resp.status
             if self.cfg.machine_tag:  # fleet tracking (app.go:93-100)
                 resp.headers["Machine-Tag"] = self.cfg.machine_tag
+            resp.headers["X-Request-Id"] = rid
             return resp
         except web.HTTPException as e:
             status = e.status
+            e.headers["X-Request-Id"] = rid
             raise
         except Exception as e:
             status = 500
             return web.json_response(
                 schema.error_body(f"{type(e).__name__}: {e}", "server_error",
-                                  500), status=500)
+                                  500), status=500,
+                headers={"X-Request-Id": rid})
         finally:
+            tr = telemetry.maybe_tracer()
+            if tr is not None and request.path not in _OPEN_PATHS:
+                tr.add_complete(f"http {request.path}", t0, cat="http",
+                                args={"request_id": rid, "status": status,
+                                      "method": request.method})
+            telemetry.reset_request_id(rid_token)
             if _HAVE_PROM:
                 _API_CALLS.labels(request.path, str(status)).inc()
                 _API_LATENCY.labels(request.path).observe(
@@ -325,8 +356,81 @@ class API:
     async def _metrics(self, request):
         if not _HAVE_PROM:
             raise web.HTTPNotImplemented()
+        await asyncio.to_thread(self._refresh_stage_gauges)
         return web.Response(body=generate_latest(),
                             content_type=CONTENT_TYPE_LATEST.split(";")[0])
+
+    def _refresh_stage_gauges(self):
+        """Pull each loaded backend's prof_* metrics into the Prometheus
+        stage gauges (best-effort — a wedged backend must not fail the
+        scrape, and profile-less runs simply publish nothing)."""
+        for name in self.manager.loaded():
+            h = self.manager.get(name)
+            if h is None:
+                continue
+            try:
+                m = h.client.metrics(timeout=2.0)
+            except Exception:
+                continue
+            for key, v in m.items():
+                if not key.startswith("prof_"):
+                    continue
+                stage, _, kind = key[5:].rpartition("_")
+                if kind == "count":
+                    _STAGE_DISPATCHES.labels(name, stage).set(v)
+                elif kind == "s" and stage.endswith("_tok"):
+                    _STAGE_TOK_S.labels(name, stage[:-4]).set(v)
+                elif kind == "ms" and stage.endswith("_total"):
+                    _STAGE_SECONDS.labels(name, stage[:-6]).set(v / 1e3)
+
+    async def _backend_traces(self, model: str = "") -> list[dict]:
+        """GetTrace payloads from the loaded backends ({} on any failure)."""
+        out = []
+        for name in self.manager.loaded():
+            if model and name != model:
+                continue
+            h = self.manager.get(name)
+            if h is None:
+                continue
+            try:
+                payload = await asyncio.to_thread(
+                    lambda hh=h: hh.client.trace())
+            except Exception:
+                payload = {}
+            # key by the config name — the backend reports its checkpoint
+            # path as model_name, which is not what clients query by
+            payload["model"] = name
+            out.append(payload)
+        return out
+
+    async def _debug_trace(self, request):
+        """GET /debug/trace[?model=x] → Chrome-trace JSON merging this
+        process's spans with every backend subprocess's (load it at
+        chrome://tracing or ui.perfetto.dev). Empty traceEvents unless the
+        server runs with LOCALAI_TRACE=1."""
+        events = list(telemetry.chrome_events())
+        names = {os.getpid(): "localai-http"}
+        for payload in await self._backend_traces(
+                request.query.get("model", "")):
+            events.extend(payload.get("spans") or [])
+            if payload.get("pid"):
+                names[payload["pid"]] = f"backend:{payload['model']}"
+        events.sort(key=lambda e: e.get("ts", 0))
+        return web.json_response(telemetry.chrome_trace(events, names))
+
+    async def _debug_profile(self, request):
+        """GET /debug/profile[?model=x] → per-model device-step stage
+        breakdown (histograms, tokens/s, MFU) from the engine profiler.
+        Stages populate only under LOCALAI_PROFILE=1."""
+        profiles = {}
+        for payload in await self._backend_traces(
+                request.query.get("model", "")):
+            profiles[payload["model"]] = payload.get("profile") or {}
+        return web.json_response({
+            "tracing_enabled": telemetry.trace_enabled(),
+            "profiling_enabled": telemetry.profile_enabled(),
+            "models": profiles,
+        })
 
     async def _models(self, request):
         return web.json_response(schema.models_list(self.configs.names()))
@@ -410,11 +514,15 @@ class API:
             text = reply.message.decode("utf-8", "replace")
             tool_calls = None
             if tools_active:
-                # grammar-constrained output → OpenAI tool_calls
-                # (reference: pkg/functions/parse.go wired at chat.go:266-312)
-                from localai_tpu.functions import parse_tool_calls
+                # grammar-constrained output → OpenAI tool_calls; the
+                # no-action "answer" alternative unwraps back into prose
+                # (reference: pkg/functions/parse.go + functions.go no-action,
+                # wired at chat.go:266-312)
+                from localai_tpu.functions import parse_tool_response
 
-                tool_calls = parse_tool_calls(text)
+                tool_calls, answer = parse_tool_response(text)
+                if answer is not None:
+                    text = answer
             resp = schema.chat_completion(
                 cfg.name, text,
                 reply.finish_reason, reply.prompt_tokens, reply.tokens,
@@ -467,14 +575,19 @@ class API:
             if reply.finish_reason:
                 finish = reply.finish_reason
         if tools_active:
-            from localai_tpu.functions import parse_tool_calls
+            from localai_tpu.functions import parse_tool_response
 
             full = "".join(buffered)
-            calls = parse_tool_calls(full)
+            calls, answer = parse_tool_response(full)
             if calls:
                 await send(schema.chat_chunk(rid, cfg.name, None,
                                              tool_calls=calls))
                 finish = "tool_calls"
+            elif answer is not None:
+                # the no-action "answer" alternative: emit its message as a
+                # plain content delta (prose, not a forced tool call)
+                if answer:
+                    await send(schema.chat_chunk(rid, cfg.name, answer))
             elif full:
                 await send(schema.chat_chunk(rid, cfg.name, full))
         await send(schema.chat_chunk(rid, cfg.name, None, finish_reason=finish))
@@ -709,6 +822,11 @@ class API:
                     sub[f] = body[f]
             if it < max_iter - 1:
                 sub["tools"] = tools   # final round: force a prose answer
+                # the agent loop's contract is call-then-answer: non-final
+                # rounds must produce a tool call (tool_choice "required"
+                # keeps the no-action "answer" alternative out of the
+                # grammar here — the final tool-less round is the answer)
+                sub["tool_choice"] = "required"
                 # a truncated tool-call JSON cannot parse — give the
                 # grammar-constrained round enough budget to close the braces
                 sub["max_tokens"] = max(int(sub.get("max_tokens") or 0), 128)
@@ -1205,6 +1323,12 @@ def run_server(args) -> int:
 
     env_file = getattr(args, "env_file", None)
     load_env_files([env_file] if env_file else None)
+    # --trace/--profile go through the environment so the ModelManager's
+    # backend subprocesses (which inherit os.environ) pick them up too
+    if getattr(args, "trace", False):
+        os.environ["LOCALAI_TRACE"] = "1"
+    if getattr(args, "profile", False):
+        os.environ["LOCALAI_PROFILE"] = "1"
     app_cfg = AppConfig.from_env(
         address=getattr(args, "address", None),
         models_path=getattr(args, "models_path", None),

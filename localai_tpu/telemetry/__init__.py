@@ -1,0 +1,39 @@
+"""Telemetry subsystem: end-to-end request tracing + device-step profiling.
+
+Three pieces (see ISSUE 2 / ROADMAP open item #1 — the 33 ms decode step has
+never been decomposed):
+
+- `trace`: a lock-free ring-buffer span tracer with request-id propagation
+  HTTP middleware → gRPC metadata → engine, exported as Chrome-trace JSON
+  (`/debug/trace`, `local-ai util trace`, `bench.py --trace`).
+- `profiler`: opt-in `block_until_ready`-fenced per-stage timing of the
+  engine's device dispatches (admit / prefill / decode block / sample /
+  shift), accumulated into histograms with tokens/s + MFU estimates
+  (`/debug/profile`, GetMetrics `prof_*` keys, Prometheus series).
+- exporters live with their surfaces: the HTTP server merges spans across
+  processes via the backend GetTrace RPC.
+
+Enable with `LOCALAI_TRACE=1` (spans) and `LOCALAI_PROFILE=1` (fenced stage
+timing). Both default off; the serving hot path is untouched when disabled.
+"""
+from localai_tpu.telemetry.trace import (  # noqa: F401
+    Tracer,
+    chrome_events,
+    chrome_trace,
+    current_request_id,
+    maybe_tracer,
+    new_request_id,
+    reset_request_id,
+    set_request_id,
+    set_trace_enabled,
+    span,
+    trace_enabled,
+    tracer,
+)
+from localai_tpu.telemetry.profiler import (  # noqa: F401
+    StepProfiler,
+    engine_profiler,
+    peak_flops,
+    profile_enabled,
+    set_profile_enabled,
+)

@@ -162,6 +162,69 @@ def test_tools_schema_shape():
     assert s["properties"]["name"]["const"] == "f"
 
 
+def test_tools_answer_no_action_alternative():
+    """tool_choice auto (or absent) includes the reference's no-action
+    "answer" alternative so the grammar can produce prose; required /
+    pinned choices stay tool-only (reference pkg/functions/functions.go)."""
+    from localai_tpu.functions import parse_tool_response
+
+    tools = [{"type": "function", "function": {
+        "name": "get_weather", "parameters": {"type": "object"}}}]
+    s = tools_schema(tools, allow_answer=True)
+    names = [a["properties"]["name"]["const"] for a in s["oneOf"]]
+    assert names == ["get_weather", "answer"]
+
+    assert '"\\"answer\\""' in grammar_for_request({"tools": tools})
+    assert '"\\"answer\\""' in grammar_for_request(
+        {"tools": tools, "tool_choice": "auto"})
+    assert '"\\"answer\\""' not in grammar_for_request(
+        {"tools": tools, "tool_choice": "required"})
+    assert '"\\"answer\\""' not in grammar_for_request(
+        {"tools": tools,
+         "tool_choice": {"type": "function",
+                         "function": {"name": "get_weather"}}})
+
+    # parse_tool_response unwraps the no-action object into prose content
+    calls, answer = parse_tool_response(
+        '{"name": "answer", "arguments": {"message": "it is sunny"}}')
+    assert calls is None and answer == "it is sunny"
+    calls, answer = parse_tool_response(
+        '{"name": "get_weather", "arguments": {"city": "Oslo"}}')
+    assert answer is None and calls[0]["function"]["name"] == "get_weather"
+    assert parse_tool_response("plain prose") == (None, None)
+
+
+def test_template_unsupported_fields_warn(caplog):
+    """LocalAI YAMLs using the reference's functions/multimodal/reply-prefix
+    template fields get a structured warning instead of silent dropping
+    (VERDICT Weak #8)."""
+    import logging
+
+    from localai_tpu.config import ModelConfig
+
+    with caplog.at_level(logging.WARNING, logger="localai_tpu"):
+        cfg = ModelConfig.from_dict({"name": "ported", "template": {
+            "chat": "tmpl", "function": "fn-tmpl", "multimodal": "mm",
+            "reply_prefix": "> ",
+        }})
+    assert cfg.unsupported_template_fields == [
+        "function", "multimodal", "reply_prefix"]
+    warning = "\n".join(r.getMessage() for r in caplog.records)
+    assert "ported" in warning and "reply_prefix" in warning
+    assert "function" in warning and "multimodal" in warning
+    # supported-only templates stay silent
+    caplog.clear()
+    with caplog.at_level(logging.WARNING, logger="localai_tpu"):
+        clean = ModelConfig.from_dict(
+            {"name": "ok", "template": {"chat": "tmpl"}})
+    assert clean.unsupported_template_fields == []
+    assert not caplog.records
+    # empty values don't count as usage
+    quiet = ModelConfig.from_dict(
+        {"name": "q", "template": {"reply_prefix": ""}})
+    assert quiet.unsupported_template_fields == []
+
+
 # ------------------------------------------------------------------ watchdog
 
 def test_watchdog_reaps_idle(tmp_path, tmp_path_factory):

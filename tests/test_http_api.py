@@ -375,8 +375,10 @@ def test_tools_returns_tool_calls(stack):
     }, timeout=300)
     assert r.status_code == 200, r.text
     choice = r.json()["choices"][0]
-    # grammar forces {"name": "get_weather", "arguments": {...}}; if decoding
-    # hit max_tokens mid-object the parse legitimately yields plain content
+    # grammar forces {"name": <tool|answer>, "arguments": {...}}; the
+    # no-action "answer" alternative (tool_choice auto) unwraps to prose
+    # content, and hitting max_tokens mid-object legitimately yields the
+    # raw partial text
     if choice["finish_reason"] == "tool_calls":
         msg = choice["message"]
         assert msg["content"] is None
@@ -387,7 +389,7 @@ def test_tools_returns_tool_calls(stack):
         assert isinstance(args, dict)
         assert calls[0]["id"].startswith("call_")
     else:
-        assert choice["message"]["content"].startswith("{")
+        assert isinstance(choice["message"]["content"], str)
 
 
 def test_tools_streaming_tool_call_delta(stack):
@@ -422,8 +424,10 @@ def test_tools_streaming_tool_call_delta(stack):
         assert tc["index"] == 0
         assert tc["function"]["name"] == "get_weather"
     else:
-        # ran out of tokens mid-JSON: buffered text must still be delivered
-        assert any(d.get("content") for d in deltas)
+        # no-action "answer" (possibly with an empty message) or truncated
+        # JSON — either way the stream must have terminated cleanly with a
+        # finish chunk, and any buffered text arrives as content deltas
+        assert finishes, "stream ended without a finish_reason chunk"
 
 
 def test_realtime_websocket_text_session(stack):

@@ -1,0 +1,101 @@
+"""CI telemetry smoke: serve a tiny model through the real process boundary
+with tracing+profiling on, then write the merged Chrome-trace artifact.
+
+This is the scoreboard-path exerciser the tier-1 CI job uploads: a
+ModelManager-spawned gRPC backend (the same surface /v1/chat/completions
+rides), a few concurrent PredictStream requests, then GetTrace → one
+Chrome-trace JSON whose spans cover rpc → grpc → engine stages.
+
+Usage: python tools/trace_smoke.py [--out trace_smoke.json]
+Exit code is non-zero when the trace is missing the expected layers, so the
+CI step is an assertion, not just an artifact producer.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ["LOCALAI_TRACE"] = "1"
+os.environ["LOCALAI_PROFILE"] = "1"
+os.environ["LOCALAI_ALLOW_SYNTHETIC"] = "1"
+os.environ["LOCALAI_NO_PREWARM"] = "1"
+os.environ.setdefault("LOCALAI_JAX_PLATFORM", "cpu")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="trace_smoke.json")
+    ap.add_argument("--requests", type=int, default=3)
+    args = ap.parse_args()
+
+    from bench import write_synthetic_checkpoint
+
+    from localai_tpu import telemetry
+    from localai_tpu.config import AppConfig, ModelConfig
+    from localai_tpu.core.manager import ModelManager
+
+    tmp = tempfile.mkdtemp(prefix="trace-smoke-")
+    ckpt = write_synthetic_checkpoint("tiny", os.path.join(tmp, "tiny"))
+    mcfg = ModelConfig.from_dict({
+        "name": "smoke", "backend": "llm", "context_size": 128,
+        "parallel": 2, "dtype": "float32", "prefill_buckets": [32],
+        "parameters": {"model": ckpt},
+    })
+    manager = ModelManager(AppConfig(models_path=tmp, parallel_requests=2))
+    handle = manager.load(mcfg)
+
+    def one(i: int):
+        token = telemetry.set_request_id(f"smoke-{i}")
+        try:
+            for _ in handle.client.predict_stream(
+                    prompt_ids=[1, 2, 3, 4 + i], tokens=6, ignore_eos=True,
+                    temperature=0.0, timeout=600.0):
+                pass
+        finally:
+            telemetry.reset_request_id(token)
+
+    threads = [threading.Thread(target=one, args=(i,))
+               for i in range(args.requests)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+
+    payload = handle.client.trace()
+    manager.stop_all()
+
+    events = list(payload.get("spans") or []) + telemetry.chrome_events()
+    events.sort(key=lambda e: e.get("ts", 0))
+    names = {os.getpid(): "trace-smoke", payload.get("pid", 0): "backend"}
+    with open(args.out, "w") as fh:
+        json.dump(telemetry.chrome_trace(events, names), fh)
+
+    got = {e["name"] for e in events}
+    rids = {e["args"].get("request_id") for e in events
+            if e["name"] == "engine.request"}
+    stages = (payload.get("profile") or {}).get("stages") or {}
+    print(f"wrote {args.out}: {len(events)} events, layers={sorted(got)[:8]}")
+    print(f"stage breakdown: " + ", ".join(
+        f"{k}={v['total_ms']:.1f}ms" for k, v in stages.items()))
+    want = {"engine.admit", "engine.sample", "grpc.PredictStream"}
+    missing = want - got
+    if missing:
+        print(f"FAIL: trace missing layers {missing}", file=sys.stderr)
+        return 1
+    if not {f"smoke-{i}" for i in range(args.requests)} <= rids:
+        print(f"FAIL: request ids did not round-trip ({rids})",
+              file=sys.stderr)
+        return 1
+    if not stages:
+        print("FAIL: no stage profile recorded", file=sys.stderr)
+        return 1
+    print("trace smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
