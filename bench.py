@@ -130,6 +130,25 @@ def emit_stale_artifact(art: dict, path: str, probe_error: str) -> None:
     print(json.dumps(out))
 
 
+def ensure_virtual_devices(n: int) -> None:
+    """Force an n-device CPU host platform (mode tp's virtual mesh). Must run
+    BEFORE jax initializes — XLA_FLAGS is read when the CPU client is
+    created; an existing forced count (e.g. the test harness's 8) wins."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}").strip()
+
+
+def build_tp_mesh(tp: int):
+    """('data'=1, 'model'=tp) mesh over the first tp devices."""
+    import jax
+
+    from localai_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    return build_mesh(MeshConfig(data=1, model=tp), jax.devices()[:tp])
+
+
 def write_synthetic_checkpoint(size: str, path: str) -> str:
     body = dict(SIZES[size])
     body.update(architectures=["LlamaForCausalLM"], rms_norm_eps=1e-5,
@@ -253,6 +272,10 @@ def bench_serve(args, size: str, on_cpu: bool):
         os.environ["LOCALAI_JAX_PLATFORM"] = "cpu"
     context = min(args.context, SIZES[size]["max_position_embeddings"])
 
+    if args.tensor_parallel > 1 and on_cpu:
+        # the backend subprocess inherits os.environ — give it the virtual
+        # devices the requested mesh needs
+        ensure_virtual_devices(args.tensor_parallel)
     mcfg = ModelConfig.from_dict({
         "name": f"bench-{size}",
         "backend": "llm",
@@ -264,6 +287,8 @@ def bench_serve(args, size: str, on_cpu: bool):
         "cache_type_k": "int8" if dtype in ("int8", "int4") else "",
         "kv_pages": args.kv_pages,
         "prefill_buckets": [128, min(512, context)],
+        "mesh": ({"data": 1, "model": args.tensor_parallel}
+                 if args.tensor_parallel > 1 else {}),
         "parameters": {"model": ckpt},
     })
     app = AppConfig(models_path=tmp, parallel_requests=args.slots)
@@ -366,8 +391,11 @@ def bench_serve(args, size: str, on_cpu: bool):
 
 # --------------------------------------------------------------- engine mode
 
-def bench_engine(args, size: str, on_cpu: bool, kv_pages: int | None = None):
-    """In-process Engine measurement (no RPC overhead) — kernel ceiling."""
+def bench_engine(args, size: str, on_cpu: bool, kv_pages: int | None = None,
+                 tp: int | None = None):
+    """In-process Engine measurement (no RPC overhead) — kernel ceiling.
+    `tp` > 1 runs the same workload on a (1, tp) tensor-parallel mesh
+    (weights — int8 included — and KV heads sharded on 'model')."""
     import jax
     import numpy as np
 
@@ -375,6 +403,8 @@ def bench_engine(args, size: str, on_cpu: bool, kv_pages: int | None = None):
     from localai_tpu.engine.loader import load_config, load_params
     from localai_tpu.ops.sampling import SamplingParams
 
+    tp = args.tensor_parallel if tp is None else tp
+    mesh = build_tp_mesh(tp) if tp and tp > 1 else None
     tmp = tempfile.mkdtemp(prefix="bench-ckpt-")
     ckpt = write_synthetic_checkpoint(size, os.path.join(tmp, size))
     os.environ["LOCALAI_ALLOW_SYNTHETIC"] = "1"
@@ -383,14 +413,16 @@ def bench_engine(args, size: str, on_cpu: bool, kv_pages: int | None = None):
         dtype = args.dtype or "float32"
     cfg = load_config(ckpt, dtype=dtype)
     context = min(args.context, cfg.max_position)
-    params = load_params(ckpt, cfg, dtype=dtype)
+    params = load_params(ckpt, cfg, dtype=dtype, mesh=mesh)
     jax.block_until_ready(params)
-    note("params initialized")
+    note("params initialized" + (f" (sharded over 1x{tp} mesh)" if mesh
+                                 else ""))
 
     eng = Engine(cfg, params, None, EngineConfig(
         max_slots=args.slots, max_context=context,
         prefill_buckets=(128, min(512, context)),
         prefill_chunk=min(512, context),
+        mesh=mesh,
         # mirror bench_serve's KV config (was silently dense-bf16 before:
         # 32-slot engine-mode runs OOM'd at admit compile)
         cache_type="int8" if dtype in ("int8", "int4") else "",
@@ -625,11 +657,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--size", default=None,
                    help="tiny|1b|3b|8b (default: 8b on TPU, tiny on CPU)")
     p.add_argument("--mode", default="serve",
-                   choices=["serve", "engine", "embed", "whisper", "paged"],
+                   choices=["serve", "engine", "embed", "whisper", "paged",
+                            "tp"],
                    help="serve = gRPC backend subprocess (default); engine = "
                         "in-process; paged = dense AND paged in one process "
-                        "with a paged_over_dense ratio; embed/whisper = "
-                        "BASELINE configs #3/#4")
+                        "with a paged_over_dense ratio; tp = single device "
+                        "AND an N-device tensor-parallel mesh in one process "
+                        "with a tp_over_single ratio (CPU: virtual 4-device "
+                        "mesh); embed/whisper = BASELINE configs #3/#4")
     p.add_argument("--embed-batch", type=int, default=256)
     p.add_argument("--dtype", default=None,
                    help="override weights dtype (default: int8 for 8b, else bf16)")
@@ -645,6 +680,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="paged KV pool size in 128-token blocks "
                         "(0 = dense per-slot cache); lets slot count "
                         "oversubscribe context at ctx 8192")
+    p.add_argument("--tensor-parallel", type=int, default=0,
+                   help="shard the model over N devices (mesh data=1, "
+                        "model=N; int8 weights shard too). 0 = single "
+                        "device. --mode tp runs both legs and defaults N "
+                        "to the largest axis the geometry divides into")
     p.add_argument("--trace", action="store_true",
                    help="telemetry run: record spans + fenced stage timings "
                         "(LOCALAI_TRACE/LOCALAI_PROFILE), write a "
@@ -747,6 +787,68 @@ def main(argv=None):
         if on_cpu and not args.cpu:
             out["probe_error"] = probe_error[:500]
         return emit_result(out, args)
+    if args.mode == "tp":
+        # single device vs an N-wide TP mesh, SAME workload, ONE process —
+        # the mesh twin of --mode paged. On CPU the mesh is virtual
+        # (XLA_FLAGS host-platform devices, must be set pre-jax-init).
+        n_dev = args.tensor_parallel if args.tensor_parallel > 1 else 4
+        if on_cpu:
+            ensure_virtual_devices(n_dev)
+        import jax
+
+        if on_cpu:
+            jax.config.update("jax_platforms", "cpu")
+        note("initializing device client...")
+        dev = jax.devices()[0]
+        device_kind = getattr(dev, "device_kind", dev.platform)
+        tmp = tempfile.mkdtemp(prefix="bench-ckpt-")
+        ckpt = write_synthetic_checkpoint(size, os.path.join(tmp, size))
+        os.environ["LOCALAI_ALLOW_SYNTHETIC"] = "1"
+        from localai_tpu.engine.loader import load_config
+        from localai_tpu.models.llama import max_model_axis
+
+        dtype_probe = args.dtype or ("int8" if size == "8b" else "bfloat16")
+        if on_cpu:
+            dtype_probe = args.dtype or "float32"
+        cfg = load_config(ckpt, dtype=dtype_probe)
+        # TP degree: explicit flag, else the widest axis every sharded dim
+        # divides into (mirrors the backend's auto-TP)
+        tp = args.tensor_parallel or max_model_axis(cfg, len(jax.devices()))
+        if tp < 2:
+            note(f"geometry shards over no more than {tp} device(s) — "
+                 "tp_over_single would be vacuous")
+            return 2
+        single_tps, single_ttft, context, dtype = bench_engine(
+            args, size, on_cpu, tp=0)
+        note(f"single device: {single_tps:.1f} tok/s")
+        tp_tps, tp_ttft, _, _ = bench_engine(args, size, on_cpu, tp=tp)
+        note(f"tp 1x{tp}: {tp_tps:.1f} tok/s global "
+             f"({tp_tps / max(single_tps, 1e-9):.2f}x single)")
+        n_params = param_count(size)
+        mfu = (tp_tps * 2 * n_params) / (peak_flops_per_chip(device_kind)
+                                         * tp)
+        result = {
+            "metric": f"decode tok/s (llama-{size} {dtype}, tp mesh 1x{tp} "
+                      f"vs single device, {args.slots} slots, ctx {context})",
+            # scoreboard value = per chip, like every other row
+            "value": round(tp_tps / tp, 2),
+            "unit": "tok/s/chip",
+            "vs_baseline": None if on_cpu else round(tp_tps / tp / 1000.0, 4),
+            "tp_over_single": round(tp_tps / max(single_tps, 1e-9), 4),
+            "mesh": {"data": 1, "model": tp},
+            "chips": tp,
+            "tok_s_global": round(tp_tps, 2),
+            "tok_s_per_chip": round(tp_tps / tp, 2),
+            "single_tok_s": round(single_tps, 2),
+            "ttft_p50_ms": round(tp_ttft, 2),
+            "single_ttft_p50_ms": round(single_ttft, 2),
+            "mfu": None if on_cpu else round(mfu, 4),
+            "device": device_kind,
+            "params": n_params,
+        }
+        if on_cpu and not args.cpu:
+            result["probe_error"] = probe_error[:500]
+        return emit_result(result, args)
     if args.mode == "paged":
         import jax
 
@@ -768,6 +870,10 @@ def main(argv=None):
             "vs_baseline": None if on_cpu else round(toks_per_s / 1000.0, 4),
             "dense_tok_s": round(dense_tps, 2),
             "paged_over_dense": round(toks_per_s / max(dense_tps, 1e-9), 4),
+            "mesh": None,
+            "chips": 1,
+            "tok_s_global": round(toks_per_s, 2),
+            "tok_s_per_chip": round(toks_per_s, 2),
             "ttft_p50_ms": round(ttft_ms, 2),
             "dense_ttft_p50_ms": round(dense_ttft, 2),
             "mfu": None if on_cpu else round(mfu, 4),
@@ -782,6 +888,8 @@ def main(argv=None):
         # accelerator, exactly like production serving
         toks_per_s, ttft_ms, context, dtype = bench_serve(args, size, on_cpu)
     else:
+        if on_cpu and args.tensor_parallel > 1:
+            ensure_virtual_devices(args.tensor_parallel)
         import jax
 
         if on_cpu:
@@ -792,17 +900,28 @@ def main(argv=None):
         toks_per_s, ttft_ms, context, dtype = bench_engine(args, size, on_cpu)
 
     n_params = param_count(size)
-    mfu = (toks_per_s * 2 * n_params) / peak_flops_per_chip(device_kind)
+    # a TP run measures GLOBAL tok/s over `chips` devices: the scoreboard
+    # value and MFU normalize per chip, and the mesh shape rides the JSON so
+    # a TP number can never be silently compared against a single-chip one
+    chips = args.tensor_parallel if args.tensor_parallel > 1 else 1
+    mfu = (toks_per_s * 2 * n_params) / (peak_flops_per_chip(device_kind)
+                                         * chips)
 
     # BASELINE.md's north star is tok/s/chip for the flagship on a REAL chip:
     # a CPU run is a harness smoke, not a comparable number.
     paged = f", paged {args.kv_pages} blocks" if args.kv_pages else ""
+    tp_tag = f", tp 1x{chips}" if chips > 1 else ""
     result = {
         "metric": f"decode tok/s/chip (llama-{size} {dtype}, {args.mode} path, "
-                  f"{args.slots} slots, ctx {context}{paged})",
-        "value": round(toks_per_s, 2),
+                  f"{args.slots} slots, ctx {context}{paged}{tp_tag})",
+        "value": round(toks_per_s / chips, 2),
         "unit": "tok/s",
-        "vs_baseline": None if on_cpu else round(toks_per_s / 1000.0, 4),
+        "vs_baseline": None if on_cpu else round(toks_per_s / chips / 1000.0,
+                                                 4),
+        "mesh": {"data": 1, "model": chips} if chips > 1 else None,
+        "chips": chips,
+        "tok_s_global": round(toks_per_s, 2),
+        "tok_s_per_chip": round(toks_per_s / chips, 2),
         "ttft_p50_ms": round(ttft_ms, 2),
         "mfu": None if on_cpu else round(mfu, 4),
         "device": device_kind,

@@ -106,11 +106,13 @@ class LLMServicer(BackendServicer):
             model = request.mesh_model or (len(devices) // data)
             mesh = build_mesh(MeshConfig(data=data, model=model),
                               devices[: data * model])
-        elif (len(devices) > 1
-              and request.dtype not in ("int8", "q8", "int4", "q4")):
-            # auto-TP over as many devices as the model dims divide into
-            # (a draft model rides the mesh too — sharded when its dims
-            # divide the axis, replicated otherwise)
+        elif len(devices) > 1:
+            # auto-TP over as many devices as the model dims divide into —
+            # quantized dtypes included: the loader quantizes per host-read
+            # shard under param_specs(qbits=...), so the flagship int8
+            # recipe boards the full mesh (a draft model rides the mesh
+            # too — sharded when its dims divide the axis, replicated
+            # otherwise)
             model = max_model_axis(cfg, len(devices))
             if model > 1:
                 mesh = build_mesh(MeshConfig(data=1, model=model),
@@ -174,7 +176,9 @@ class LLMServicer(BackendServicer):
                 model_ax = int(dict(zip(
                     mesh.axis_names, mesh.devices.shape)).get("model", 1))
                 if max_model_axis(dcfg, model_ax) != model_ax:
-                    dspecs = replicated_specs(dcfg)
+                    dspecs = replicated_specs(
+                        dcfg, qbits={"int8": 8, "q8": 8, "int4": 4,
+                                     "q4": 4}.get(request.dtype))
             draft = (dcfg, load_params(draft_dir, dcfg,
                                        dtype=request.dtype or None,
                                        mesh=mesh, specs=dspecs))

@@ -24,6 +24,11 @@ def _add_run(sub):
     p.add_argument("--watchdog-busy-timeout", default=None)
     p.add_argument("--single-active-backend", action="store_true")
     p.add_argument("--parallel-requests", type=int, default=8)
+    p.add_argument("--tensor-parallel", type=int, default=None,
+                   help="shard each model over N chips (Megatron-style TP "
+                        "on the 'model' mesh axis; int8 weights shard too). "
+                        "A per-model YAML `mesh:` block overrides this; "
+                        "default: auto-TP over every divisible device")
     p.add_argument("--backends-path", default=None,
                    help="installed external backends dir")
     p.add_argument("--backend-galleries", default=None,

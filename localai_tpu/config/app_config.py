@@ -17,6 +17,9 @@ class AppConfig:
                                      # backend registry index URIs
     context_size: int = 0
     parallel_requests: int = 4       # default engine slots per model
+    tensor_parallel: int = 0         # default TP degree ('model' mesh axis)
+                                     # for models without their own mesh:
+                                     # block; 0 = backend auto-TP
     api_keys: list[str] = dataclasses.field(default_factory=list)
     federation_token: str = ""       # shared-token HMAC (federation/auth.py);
                                      # a valid X-LocalAI-Federation signature
@@ -39,7 +42,7 @@ class AppConfig:
         cfg = cls()
         for field, cast in [("address", str), ("models_path", str),
                             ("context_size", int), ("parallel_requests", int),
-                            ("machine_tag", str)]:
+                            ("tensor_parallel", int), ("machine_tag", str)]:
             v = env(field.upper(), cast)
             if v is not None:
                 setattr(cfg, field, v)

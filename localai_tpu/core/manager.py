@@ -134,7 +134,9 @@ class ModelManager:
             dtype=m.dtype,
             prefill_buckets=m.prefill_buckets,
             mesh_data=m.mesh.data,
-            mesh_model=m.mesh.model,
+            # per-model YAML mesh wins; else the app-wide --tensor-parallel
+            # degree (0 = backend auto-TP over every divisible device)
+            mesh_model=m.mesh.model or cfg.tensor_parallel,
             embeddings=m.embeddings or m.backend == "embedding",
             draft_model=(m.draft_model if not m.draft_model
                          or os.path.isabs(m.draft_model)

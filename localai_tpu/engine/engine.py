@@ -295,7 +295,7 @@ class Engine:
         # load + branch (see _obs) — the hot path stays fence-free
         from localai_tpu import telemetry
 
-        self._prof = telemetry.engine_profiler(cfg)
+        self._prof = telemetry.engine_profiler(cfg, mesh=self.mesh)
         self._tracer = telemetry.maybe_tracer()
 
         self._build_jit()
@@ -343,6 +343,24 @@ class Engine:
             else:
                 self._kc, self._vc = init_kv_cache(
                     cfg, B, T, dtype, cache_type=self.ec.cache_type)
+            if self.mesh is not None and jax.process_count() == 1:
+                # pre-place the KV state under its serving sharding (slots
+                # on 'data', KV heads on 'model'; paged pool: block axis
+                # replicated) so the first donated dispatch doesn't pay a
+                # layout move and GSPMD never defaults the pool to
+                # replicated. safe_sharding degrades non-dividing axes to
+                # replicated instead of refusing to serve.
+                from localai_tpu.models.llama import (
+                    kv_cache_spec, paged_pool_spec,
+                )
+                from localai_tpu.parallel.mesh import safe_sharding
+
+                kv_spec = paged_pool_spec() if self._paged \
+                    else kv_cache_spec()
+                place = lambda t: jax.tree_util.tree_map(  # noqa: E731
+                    lambda a: jax.device_put(
+                        a, safe_sharding(self.mesh, kv_spec, a.shape)), t)
+                self._kc, self._vc = place(self._kc), place(self._vc)
             self._sampler = SamplerState.init(B, V)
             self._last_logits = jnp.zeros((B, V), jnp.float32)
             self._lengths = jnp.zeros((B,), jnp.int32)

@@ -217,15 +217,18 @@ def load_params(
     as a NamedSharding'ed jax.Array per param_specs (Megatron-style TP).
 
     dtype="int8"/"int4" loads bf16 then quantizes projections per output
-    channel (ops/quant.quantize_params — the GGUF-quant analog, int4 being
-    the exllama2/Q4 role); currently a single-chip path (param_specs doesn't
-    cover the {q, s} leaves yet).
+    channel (the GGUF-quant analog, int4 being the exllama2/Q4 role). On a
+    single chip that happens on device (ops/quant.quantize_params); under a
+    `mesh` each projection quantizes PER HOST-READ SHARD (numpy, right after
+    the safetensors read) and only the int8 payload + f32 scales are
+    device_put under param_specs(cfg, qbits=...) — the full bf16 stack is
+    never materialized on one host buffer or one chip, which is what lets
+    an 8B int8 recipe board a 16GB-per-chip v5e-8.
     """
     qbits = {"int8": 8, "q8": 8, "int4": 4, "q4": 4}.get(dtype)
     quantize = qbits is not None
+    host_quant = quantize and mesh is not None
     if quantize:
-        if mesh is not None:
-            raise NotImplementedError("weight quantization under a mesh")
         dtype = "bfloat16"
     dtype = jnp.dtype(dtype) if dtype is not None else cfg.jdtype
 
@@ -238,16 +241,41 @@ def load_params(
 
     r = _TensorReader(model_dir)
     if mesh is not None and specs is None:
-        specs = param_specs(cfg)
+        specs = param_specs(cfg, qbits=qbits if host_quant else None)
 
     def put(x, spec):
         # host numpy → cast on host → single device_put (sharded when meshed)
+        if isinstance(x, dict):
+            # host-quantized {"q", "s"} (mesh path): spec is the matching
+            # {"q", "s"} dict from param_specs(qbits=...). int4 ships in an
+            # int8 container and casts AFTER the sharded placement (the
+            # elementwise astype runs distributed, never regathering)
+            q = jax.device_put(x["q"], NamedSharding(mesh, spec["q"]))
+            if qbits == 4:
+                q = q.astype(jnp.int4)
+            return {"q": q,
+                    "s": jax.device_put(x["s"], NamedSharding(mesh, spec["s"]))}
         x = x if x.dtype == dtype else x.astype(dtype)
         if mesh is not None:
             return jax.device_put(x, NamedSharding(mesh, spec))
         return jnp.asarray(x)
 
-    def stack(fmt: str, transpose: bool):
+    def hq(t: np.ndarray):
+        # mirror the device path bit for bit: checkpoint dtype → bf16 (the
+        # load cast) → f32 quantization (quantize_np == ops.quant.quantize)
+        from localai_tpu.ops.quant import quantize_np
+
+        return quantize_np(np.asarray(t).astype(dtype), qbits)
+
+    def stack(fmt: str, transpose: bool, quant: bool = False):
+        if quant and host_quant:
+            qs, ss = [], []
+            for i in range(cfg.num_layers):
+                t = r.get(fmt.format(i=i))
+                d = hq(t.T if transpose else t)
+                qs.append(d["q"])
+                ss.append(d["s"])
+            return {"q": np.stack(qs), "s": np.stack(ss)}
         ts = []
         for i in range(cfg.num_layers):
             t = r.get(fmt.format(i=i))
@@ -257,16 +285,25 @@ def load_params(
     L = "model.layers.{i}."
     layers = {
         "attn_norm": stack(L + "input_layernorm.weight", False),
-        "wq": stack(L + "self_attn.q_proj.weight", True),
-        "wk": stack(L + "self_attn.k_proj.weight", True),
-        "wv": stack(L + "self_attn.v_proj.weight", True),
-        "wo": stack(L + "self_attn.o_proj.weight", True),
+        "wq": stack(L + "self_attn.q_proj.weight", True, quant=True),
+        "wk": stack(L + "self_attn.k_proj.weight", True, quant=True),
+        "wv": stack(L + "self_attn.v_proj.weight", True, quant=True),
+        "wo": stack(L + "self_attn.o_proj.weight", True, quant=True),
         "mlp_norm": stack(L + "post_attention_layernorm.weight", False),
     }
     if cfg.num_experts:
         # Mixtral MoE: experts stacked [L, E, in, out]
         # (block_sparse_moe.gate + experts.N.w{1,2,3})
         def stack_experts(which: str):
+            if host_quant:
+                qs, ss = [], []
+                for i in range(cfg.num_layers):
+                    row = [hq(r.get(f"model.layers.{i}.block_sparse_moe."
+                                    f"experts.{e}.{which}.weight").T)
+                           for e in range(cfg.num_experts)]
+                    qs.append(np.stack([d["q"] for d in row]))
+                    ss.append(np.stack([d["s"] for d in row]))
+                return {"q": np.stack(qs), "s": np.stack(ss)}
             out = []
             for i in range(cfg.num_layers):
                 row = [r.get(f"model.layers.{i}.block_sparse_moe."
@@ -282,9 +319,9 @@ def load_params(
         layers["moe_w3"] = stack_experts("w3")
     else:
         layers.update({
-            "w_gate": stack(L + "mlp.gate_proj.weight", True),
-            "w_up": stack(L + "mlp.up_proj.weight", True),
-            "w_down": stack(L + "mlp.down_proj.weight", True),
+            "w_gate": stack(L + "mlp.gate_proj.weight", True, quant=True),
+            "w_up": stack(L + "mlp.up_proj.weight", True, quant=True),
+            "w_down": stack(L + "mlp.down_proj.weight", True, quant=True),
         })
     if cfg.qkv_bias:
         layers["bq"] = stack(L + "self_attn.q_proj.bias", False)
@@ -309,9 +346,10 @@ def load_params(
             raise ValueError(
                 "config says untied embeddings but lm_head.weight is missing"
             )
-        params["lm_head"] = put(r.get(name).T, specs["lm_head"] if specs else None)
+        head = hq(r.get(name).T) if host_quant else r.get(name).T
+        params["lm_head"] = put(head, specs["lm_head"] if specs else None)
     r.close()
-    if quantize:
+    if quantize and not host_quant:
         from localai_tpu.ops.quant import quantize_params
 
         params = quantize_params(params, bits=qbits)
@@ -322,7 +360,8 @@ def _synthetic_params(cfg: LlamaConfig, *, dtype, mesh=None, qbits=None,
                       specs=None):
     """Deterministic random params at any scale. The quantized case generates
     the {q, s} leaves DIRECTLY — an 8B bf16 intermediate would not fit
-    next to itself on a 16GB chip."""
+    next to itself on a 16GB chip — and, under a mesh, shards them per
+    param_specs(qbits=...) like the safetensors path."""
     from localai_tpu.models.llama import init_params
     from localai_tpu.parallel.mesh import shard_params
 
@@ -381,6 +420,9 @@ def _synthetic_params(cfg: LlamaConfig, *, dtype, mesh=None, qbits=None,
     }
     if not cfg.tie_embeddings:
         params["lm_head"] = qrand(ks[8], (h, cfg.vocab_size), h)
+    if mesh is not None:
+        params = shard_params(params, specs or param_specs(cfg, qbits=qbits),
+                              mesh)
     return params
 
 

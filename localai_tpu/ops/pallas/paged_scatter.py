@@ -44,6 +44,11 @@ from localai_tpu.ops.pallas.flash_attention import (
     _interpret,
 )
 
+try:                                  # jax >= 0.5 top-level export
+    from jax import shard_map as _shard_map
+except ImportError:                   # 0.4.x spelling
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 
 def _targets(positions, table, active):
     """(physical block [B], in-block row [B]) for each slot's new token.
@@ -113,6 +118,68 @@ def paged_scatter_append(k_pool, v_pool, k_new, v_new, positions, table,
             dimension_semantics=("arbitrary",)),
         interpret=_interpret(),
     )(pb, off, kn, vn, k_pool, v_pool)
+
+
+def _head_axis(mesh):
+    """Mesh axis the pool's KV-head dim shards on (None on a data-only
+    mesh — every shard then holds the full head set)."""
+    return "model" if "model" in mesh.axis_names else None
+
+
+def paged_scatter_append_sharded(mesh, k_pool, v_pool, k_new, v_new,
+                                 positions, table, active=None):
+    """TP wrapper: run the scatter-append kernel per-shard via shard_map
+    over the pool's KV-head axis (models/llama.py paged_pool_spec).
+
+    pallas_call has no GSPMD partitioning rule, so calling the kernel
+    directly under a mesh would make the partitioner all-gather the whole
+    pool — exactly the traffic the kernel exists to avoid. Inside shard_map
+    each model-shard DMAs its local [KVH/tp, 1, D] rows; positions/table/
+    active are replicated scalars-per-slot, so every shard computes the same
+    block targets. check_rep=False: the kernel body is opaque to the
+    replication checker."""
+    from jax.sharding import PartitionSpec as P
+
+    ax = _head_axis(mesh)
+    pool, new, rep = P(None, ax, None, None), P(None, ax, None), P()
+    if active is None:
+        return _shard_map(
+            lambda kp, vp, kn, vn, pos, tab: paged_scatter_append(
+                kp, vp, kn, vn, pos, tab),
+            mesh=mesh, in_specs=(pool, pool, new, new, rep, rep),
+            out_specs=(pool, pool), check_rep=False,
+        )(k_pool, v_pool, k_new, v_new, positions, table)
+    return _shard_map(
+        lambda kp, vp, kn, vn, pos, tab, act: paged_scatter_append(
+            kp, vp, kn, vn, pos, tab, act),
+        mesh=mesh, in_specs=(pool, pool, new, new, rep, rep, rep),
+        out_specs=(pool, pool), check_rep=False,
+    )(k_pool, v_pool, k_new, v_new, positions, table, active)
+
+
+def paged_scatter_append_q8_sharded(mesh, kq, ks, vq, vs, k_new, v_new,
+                                    positions, table, active=None):
+    """int8 twin of paged_scatter_append_sharded: the scale pools
+    [NB, KVH, 1, BS] shard their KV-head axis alongside the int8 bodies."""
+    from jax.sharding import PartitionSpec as P
+
+    ax = _head_axis(mesh)
+    pool = P(None, ax, None, None)
+    new, rep = P(None, ax, None), P()
+    specs4 = (pool, pool, pool, pool, new, new, rep, rep)
+    if active is None:
+        return _shard_map(
+            lambda a, b, c, d, kn, vn, pos, tab: paged_scatter_append_q8(
+                a, b, c, d, kn, vn, pos, tab),
+            mesh=mesh, in_specs=specs4, out_specs=(pool,) * 4,
+            check_rep=False,
+        )(kq, ks, vq, vs, k_new, v_new, positions, table)
+    return _shard_map(
+        lambda a, b, c, d, kn, vn, pos, tab, act: paged_scatter_append_q8(
+            a, b, c, d, kn, vn, pos, tab, act),
+        mesh=mesh, in_specs=specs4 + (rep,), out_specs=(pool,) * 4,
+        check_rep=False,
+    )(kq, ks, vq, vs, k_new, v_new, positions, table, active)
 
 
 def _append_q8_kernel(pb_ref, off_ref, kq_new_ref, ks_new_ref, vq_new_ref,

@@ -34,6 +34,26 @@ def quantize(w, bits: int = 8):
     return {"q": q, "s": scale.astype(jnp.float32)}
 
 
+def quantize_np(w, bits: int = 8):
+    """Host-side (numpy) mirror of `quantize`, for the mesh-sharded loader:
+    each safetensors shard quantizes right after its host read, so only the
+    int8 payload + f32 scales ever cross `device_put` — the full bf16 stack
+    is never materialized on host or chip. Bit-identical to the device path
+    (IEEE max/div/mul, round-half-even). int4 keeps an int8 container; the
+    loader casts to jnp.int4 AFTER the sharded placement (numpy has no int4).
+    """
+    import numpy as np
+
+    if bits not in (4, 8):
+        raise ValueError(f"unsupported quantization width {bits}")
+    qmax = 7 if bits == 4 else 127
+    w32 = np.asarray(w, np.float32)
+    amax = np.max(np.abs(w32), axis=-2, keepdims=True)
+    scale = np.maximum(amax, 1e-8) / qmax
+    q = np.clip(np.rint(w32 / scale), -qmax, qmax).astype(np.int8)
+    return {"q": q, "s": scale.astype(np.float32)}
+
+
 def is_quantized(p) -> bool:
     return isinstance(p, dict) and set(p.keys()) == {"q", "s"}
 
@@ -42,14 +62,27 @@ def dequantize(p, dtype=jnp.bfloat16):
     return (p["q"].astype(jnp.float32) * p["s"]).astype(dtype)
 
 
-def qmatmul(x, p):
-    """x @ W for a (possibly) quantized W; activations keep their dtype."""
+def qmatmul(x, p, spec=None):
+    """x @ W for a (possibly) quantized W; activations keep their dtype.
+
+    `spec` (optional PartitionSpec) is an output-activation sharding hint:
+    under an active mesh it is applied as a hard constraint so GSPMD keeps
+    the (possibly int8) weight resident-sharded and computes the local
+    partial product instead of all-gathering W — the TP decode contract.
+    Callers inside shard_map must leave it None (constraints are illegal
+    under manual axes)."""
     if not is_quantized(p):
-        return x @ p
-    # int8 → activation dtype, scale folded per output channel
-    w = p["q"].astype(x.dtype)
-    y = x @ w
-    return y * p["s"].reshape((1,) * (y.ndim - 1) + (-1,)).astype(y.dtype)
+        y = x @ p
+    else:
+        # int8 → activation dtype, scale folded per output channel
+        w = p["q"].astype(x.dtype)
+        y = x @ w
+        y = y * p["s"].reshape((1,) * (y.ndim - 1) + (-1,)).astype(y.dtype)
+    if spec is not None:
+        from localai_tpu.parallel.mesh import constrain
+
+        y = constrain(y, spec)
+    return y
 
 
 def quantize_params(params, *, bits: int = 8, skip=("embed", "final_norm")):

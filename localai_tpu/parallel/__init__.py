@@ -4,5 +4,8 @@ from localai_tpu.parallel.mesh import (  # noqa: F401
     build_mesh,
     constrain,
     current_mesh,
+    mesh_shape,
+    safe_sharding,
     shard_params,
+    validate_specs,
 )

@@ -100,8 +100,64 @@ def constrain(x, spec: PartitionSpec):
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
 
+def mesh_shape(mesh: Mesh | None) -> dict[str, int] | None:
+    """Mesh axes as a plain {'data': d, 'model': m, ...} dict (None without a
+    mesh) — the serializable shape telemetry/bench artifacts record."""
+    if mesh is None:
+        return None
+    return {name: int(size)
+            for name, size in zip(mesh.axis_names, mesh.devices.shape)}
+
+
+def validate_specs(params, specs) -> None:
+    """Every param leaf must carry a PartitionSpec of the leaf's rank (or the
+    empty P(), explicit full replication). A missing leaf or wrong-rank spec
+    raises naming the offender — under GSPMD a short spec would otherwise
+    silently replicate the trailing axes, which for a TP'd weight means a
+    full copy per chip and no error anywhere."""
+    def check(path, p, s):
+        name = jax.tree_util.keystr(path)
+        if not isinstance(s, PartitionSpec):
+            raise ValueError(
+                f"param {name}: spec is {type(s).__name__}, not a "
+                f"PartitionSpec")
+        ndim = getattr(p, "ndim", np.ndim(p))
+        if len(s) not in (0, ndim):
+            raise ValueError(
+                f"param {name} has rank {ndim} but spec {s} has rank "
+                f"{len(s)} — a wrong-rank spec would silently replicate")
+
+    try:
+        jax.tree_util.tree_map_with_path(check, params, specs)
+    except (KeyError, TypeError) as e:
+        # tree-structure mismatch (missing/extra spec leaf)
+        raise ValueError(f"param/spec tree mismatch: {e}") from e
+
+
 def shard_params(params, specs, mesh: Mesh):
-    """device_put every leaf with its PartitionSpec → sharded jax.Arrays."""
+    """device_put every leaf with its PartitionSpec → sharded jax.Arrays.
+    Validates spec coverage/rank first (see validate_specs)."""
+    validate_specs(params, specs)
     return jax.tree_util.tree_map(
         lambda p, s: jax.device_put(p, NamedSharding(mesh, s)), params, specs
     )
+
+
+def safe_sharding(mesh: Mesh, spec: PartitionSpec, shape) -> NamedSharding:
+    """NamedSharding for `spec` with any axis whose mesh size does not divide
+    the corresponding dim dropped to replicated — the pre-placement helper
+    for serving state (KV caches/pools), where an odd slot or head count
+    should degrade to replication, not refuse to serve. Params go through
+    shard_params, which refuses instead."""
+    axes = []
+    entries = tuple(spec) + (None,) * (len(shape) - len(spec))
+    for dim, ax in zip(shape, entries):
+        if ax is None:
+            axes.append(None)
+            continue
+        names = ax if isinstance(ax, (tuple, list)) else (ax,)
+        size = 1
+        for n in names:
+            size *= mesh.shape[n]
+        axes.append(ax if size and dim % size == 0 else None)
+    return NamedSharding(mesh, PartitionSpec(*axes))

@@ -18,7 +18,10 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+try:                                  # jax >= 0.5 top-level export
+    from jax import shard_map
+except ImportError:                   # 0.4.x spelling
+    from jax.experimental.shard_map import shard_map
 
 NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
 

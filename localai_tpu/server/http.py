@@ -1334,6 +1334,7 @@ def run_server(args) -> int:
         models_path=getattr(args, "models_path", None),
         context_size=getattr(args, "context_size", None),
         parallel_requests=getattr(args, "parallel_requests", None),
+        tensor_parallel=getattr(args, "tensor_parallel", None),
         single_active_backend=getattr(args, "single_active_backend", None),
         api_keys=getattr(args, "api_keys", None),
     )

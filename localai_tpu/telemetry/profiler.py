@@ -102,10 +102,18 @@ class StepProfiler:
     already pays a fence per dispatch, a mutex is noise)."""
 
     def __init__(self, fence: bool = True, n_params: int = 0,
-                 peak: float = 0.0):
+                 peak: float = 0.0, mesh: dict | None = None):
+        """`mesh` is the serving mesh shape ({'data': d, 'model': m, ...},
+        None for single chip). It is recorded in every report and scales the
+        MFU denominator by the chip count, so a TP profile can never be
+        scoreboard-read as a single-chip one."""
         self.fence = fence
         self.n_params = n_params
         self.peak = peak
+        self.mesh = dict(mesh) if mesh else None
+        self.chips = 1
+        for size in (mesh or {}).values():
+            self.chips *= max(int(size), 1)
         self._stages: dict[str, _Stage] = {}
         self._lock = threading.Lock()
         self._first_t: float | None = None
@@ -147,8 +155,9 @@ class StepProfiler:
                 mfu = None
                 if self.peak and self.n_params and st.total_s > 0 \
                         and st.tokens:
+                    # global tokens over the WHOLE mesh's peak: per-chip MFU
                     mfu = (2.0 * self.n_params * st.tokens
-                           / (st.total_s * self.peak))
+                           / (st.total_s * self.peak * self.chips))
                 stages[name] = {
                     "count": st.count,
                     "total_ms": st.total_s * 1e3,
@@ -175,6 +184,8 @@ class StepProfiler:
             "fenced": self.fence,
             "n_params": self.n_params,
             "peak_flops": self.peak,
+            "mesh": self.mesh,
+            "chips": self.chips,
         }
 
     def flat(self, prefix: str = "prof_") -> dict[str, float]:
@@ -191,12 +202,18 @@ class StepProfiler:
         return out
 
 
-def engine_profiler(cfg=None) -> StepProfiler | None:
+def engine_profiler(cfg=None, mesh=None) -> StepProfiler | None:
     """Build the engine's profiler when LOCALAI_PROFILE is set (else None —
     the engine's gate for keeping the hot path fence-free). `cfg` is a
-    LlamaConfig used for the MFU param count."""
+    LlamaConfig used for the MFU param count; `mesh` is the engine's
+    jax Mesh (or an axis-shape dict) — recorded in the artifacts."""
     if not profile_enabled():
         return None
+    shape = mesh if isinstance(mesh, dict) or mesh is None else None
+    if shape is None and mesh is not None:
+        from localai_tpu.parallel.mesh import mesh_shape
+
+        shape = mesh_shape(mesh)
     n_params = 0
     if cfg is not None:
         try:
@@ -213,4 +230,5 @@ def engine_profiler(cfg=None) -> StepProfiler | None:
         kind = getattr(d, "device_kind", d.platform)
     except Exception:
         pass
-    return StepProfiler(fence=True, n_params=n_params, peak=peak_flops(kind))
+    return StepProfiler(fence=True, n_params=n_params, peak=peak_flops(kind),
+                        mesh=shape)

@@ -1,0 +1,528 @@
+"""Tensor-parallel int8 decode (ISSUE 3): the flagship quantized recipe on a
+mesh.
+
+Covers the PR's acceptance criteria on the virtual CPU mesh:
+- `load_params(mesh=..., dtype="int8")` no longer raises; the sharded
+  quantized load quantizes per host-read shard and never materializes a full
+  stacked bf16 weight (device-put spy + a tripwire on the device-side
+  `quantize_params` path),
+- `param_specs` completeness: every leaf of `init_params` — bf16 AND the
+  quantized {q, s} trees — has a full-rank spec, and a wrong-rank spec
+  raises at shard time instead of silently replicating,
+- 4-device fused-decode-block parity against the single-device engine at
+  8 slots: dense and paged, bf16 and int8-W (incl. int8 KV and the
+  shard_map'd Pallas scatter-append tier),
+- a compiled-HLO inspection proof that the TP decode step contains no
+  full-weight all-gather (weights stay resident-sharded through the layer
+  scan; the only gather is the small vocab-parallel logits one).
+
+Everything here runs on 4 devices so the CI job with
+XLA_FLAGS=--xla_force_host_platform_device_count=4 can run the `tp` marker
+standalone.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+import sys
+import threading
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from localai_tpu.models.llama import (
+    LlamaConfig, decode_step, init_kv_cache, init_params, kv_cache_spec,
+    param_specs, prefill, replicated_specs,
+)
+from localai_tpu.ops.quant import quantize_params
+from localai_tpu.ops.rope import rope_table
+from localai_tpu.parallel.mesh import (
+    MeshConfig, activate_mesh, build_mesh, mesh_shape, shard_params,
+)
+
+pytestmark = pytest.mark.tp
+
+# every TP'd dim divisible by the 4-wide model axis (incl. the KV-head axis
+# the cache/pool shard on)
+CFG = LlamaConfig(
+    vocab_size=256, hidden_size=64, intermediate_size=128, num_layers=2,
+    num_heads=4, num_kv_heads=4, head_dim=16, max_position=512,
+    dtype="float32",
+)
+
+
+@pytest.fixture(scope="module")
+def mesh4():
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >= 4 devices")
+    return build_mesh(MeshConfig(data=1, model=4), jax.devices()[:4])
+
+
+# ------------------------------------------------------- spec completeness
+
+def _leaves_with_paths(tree):
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return {jax.tree_util.keystr(path): leaf for path, leaf in flat}
+
+
+def _cfg_variants():
+    return [
+        CFG,
+        dataclasses.replace(CFG, num_kv_heads=2),
+        dataclasses.replace(CFG, qkv_bias=True),
+        dataclasses.replace(CFG, tie_embeddings=True),
+        dataclasses.replace(CFG, num_experts=4, experts_per_tok=2),
+    ]
+
+
+@pytest.mark.parametrize("qbits", [None, 8])
+def test_param_specs_cover_every_leaf(qbits):
+    """Acceptance: every leaf of init_params — bf16 and quantized trees —
+    has a PartitionSpec of exactly the leaf's rank (full-rank specs are what
+    makes the wrong-rank check below meaningful)."""
+    for cfg in _cfg_variants():
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        if qbits:
+            params = quantize_params(params, bits=qbits)
+        specs = param_specs(cfg, qbits=qbits)
+        pleaves = _leaves_with_paths(params)
+        sleaves = _leaves_with_paths(specs)
+        assert set(pleaves) == set(sleaves), (
+            f"spec tree != param tree: only-params="
+            f"{set(pleaves) - set(sleaves)} only-specs="
+            f"{set(sleaves) - set(pleaves)}")
+        for path, spec in sleaves.items():
+            assert isinstance(spec, P), f"{path}: not a PartitionSpec"
+            assert len(spec) == pleaves[path].ndim, (
+                f"{path}: spec rank {len(spec)} != param rank "
+                f"{pleaves[path].ndim}")
+
+
+def test_replicated_specs_cover_quantized_tree():
+    qparams = quantize_params(init_params(CFG, jax.random.PRNGKey(0)))
+    specs = replicated_specs(CFG, qbits=8)
+    # structure must match exactly (tree_map raises otherwise) and every
+    # leaf replicates
+    jax.tree_util.tree_map(
+        lambda _, s: (_ for _ in ()).throw(AssertionError(s))
+        if tuple(s) not in ((), None) and any(a is not None for a in s)
+        else None,
+        qparams, specs)
+
+
+def test_wrong_rank_spec_raises_at_shard_time(mesh4):
+    """A wrong-rank spec must raise naming the leaf — not silently replicate
+    (the pre-PR failure mode for the quantized {q, s} leaves)."""
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    specs = param_specs(CFG)
+    specs["layers"]["wq"] = P(None, "model")      # rank 2 vs param rank 3
+    with pytest.raises(ValueError, match="wq"):
+        shard_params(params, specs, mesh4)
+
+
+def test_missing_spec_leaf_raises(mesh4):
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    specs = param_specs(CFG)
+    del specs["layers"]["wo"]
+    with pytest.raises((ValueError, KeyError)):
+        shard_params(params, specs, mesh4)
+
+
+# ------------------------------------------------------ sharded int8 load
+
+def _spy_device_put(monkeypatch, record):
+    real = jax.device_put
+
+    def spy(x, *a, **kw):
+        for leaf in jax.tree_util.tree_leaves(x):
+            if hasattr(leaf, "dtype"):
+                record.append((np.dtype(leaf.dtype),
+                               getattr(leaf, "ndim", 0),
+                               int(getattr(leaf, "size", 0))))
+        return real(x, *a, **kw)
+
+    monkeypatch.setattr(jax, "device_put", spy)
+
+
+def test_sharded_int8_load_never_materializes_full_bf16(
+        tmp_path_factory, mesh4, monkeypatch):
+    """Acceptance: load_params(mesh=..., qbits=8) no longer raises, the int8
+    payload + per-channel scales land under the quantized param_specs, and no
+    full stacked floating-point projection is ever device_put (quantization
+    happened per host-read shard). The device-side quantize_params path must
+    not run at all under a mesh."""
+    from fixtures import tiny_checkpoint
+    import localai_tpu.ops.quant as quant_mod
+    from localai_tpu.engine.loader import load_config, load_params
+
+    ckpt = tiny_checkpoint(tmp_path_factory)
+    cfg = load_config(ckpt, dtype="int8")
+    ref = load_params(ckpt, cfg, dtype="int8")       # single-device baseline
+
+    def boom(*a, **kw):
+        raise AssertionError(
+            "device-side quantize_params ran on the sharded load path")
+
+    monkeypatch.setattr(quant_mod, "quantize_params", boom)
+    record = []
+    _spy_device_put(monkeypatch, record)
+    params = load_params(ckpt, cfg, dtype="int8", mesh=mesh4)
+
+    # smallest stacked projection (wk/wv: [L, h, kvh*hd]) — no float array
+    # that large (or larger) with a stacked-layer rank may cross device_put
+    stack_elems = cfg.num_layers * cfg.hidden_size \
+        * cfg.num_kv_heads * cfg.head_dim
+    offenders = [r for r in record
+                 if np.issubdtype(r[0], np.floating) and r[1] >= 3
+                 and r[2] >= stack_elems]
+    assert not offenders, f"full float weight stacks device_put: {offenders}"
+
+    wq = params["layers"]["wq"]
+    assert wq["q"].dtype == jnp.int8
+    assert wq["q"].sharding.spec == P(None, None, "model")
+    assert not wq["q"].sharding.is_fully_replicated
+    assert wq["s"].sharding.spec == P(None, None, "model")
+    assert params["layers"]["wo"]["q"].sharding.spec == P(None, "model", None)
+    assert params["lm_head"]["q"].sharding.spec == P(None, "model")
+
+    # numerics: host-side per-shard quantization == the device-side
+    # quantize_params baseline, bit for bit
+    for k in ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"):
+        np.testing.assert_array_equal(
+            np.asarray(params["layers"][k]["q"]),
+            np.asarray(ref["layers"][k]["q"]), err_msg=k)
+        np.testing.assert_allclose(
+            np.asarray(params["layers"][k]["s"]),
+            np.asarray(ref["layers"][k]["s"]), rtol=0, atol=0, err_msg=k)
+    np.testing.assert_array_equal(np.asarray(params["embed"]),
+                                  np.asarray(ref["embed"]))
+
+
+def test_synthetic_int8_load_shards(tmp_path, mesh4, monkeypatch):
+    """The benchmark path: a synthetic checkpoint loaded with mesh + int8
+    generates the {q, s} leaves directly and places them sharded."""
+    from localai_tpu.engine.loader import load_config, load_params
+
+    monkeypatch.setenv("LOCALAI_ALLOW_SYNTHETIC", "1")
+    body = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                num_hidden_layers=2, num_attention_heads=4,
+                num_key_value_heads=4, head_dim=16,
+                max_position_embeddings=256, tie_word_embeddings=False,
+                architectures=["LlamaForCausalLM"], rms_norm_eps=1e-5,
+                localai_synthetic=True)
+    with open(tmp_path / "config.json", "w") as fh:
+        json.dump(body, fh)
+    cfg = load_config(str(tmp_path), dtype="int8")
+    params = load_params(str(tmp_path), cfg, dtype="int8", mesh=mesh4)
+    assert params["layers"]["wq"]["q"].dtype == jnp.int8
+    assert params["layers"]["wq"]["q"].sharding.spec == P(None, None, "model")
+    assert not params["layers"]["wq"]["q"].sharding.is_fully_replicated
+    assert params["lm_head"]["q"].sharding.spec == P(None, "model")
+
+
+# ----------------------------------------------- fused decode block parity
+
+def _collect(eng, reqs):
+    eng.start()
+    outs = {}
+
+    def run(i, req):
+        _, q = eng.submit(req)
+        ids = []
+        while True:
+            o = q.get(timeout=300)
+            if o.token_id >= 0:
+                ids.append(o.token_id)
+            if o.finished:
+                outs[i] = ids
+                return
+
+    ths = [threading.Thread(target=run, args=(i, r))
+           for i, r in enumerate(reqs)]
+    [t.start() for t in ths]
+    [t.join(timeout=600) for t in ths]
+    eng.stop()
+    return outs
+
+
+def _reqs(cfg, n, max_tokens=14):
+    from localai_tpu.engine import GenRequest
+    from localai_tpu.ops.sampling import SamplingParams
+
+    rng = np.random.default_rng(7)
+    return [GenRequest(
+        rng.integers(5, cfg.vocab_size, 6).tolist(),
+        SamplingParams(temperature=0.0),
+        max_tokens=max_tokens, ignore_eos=True) for _ in range(n)]
+
+
+def _run_engine(cfg, params, mesh, *, kv_pages=0, cache_type=""):
+    from localai_tpu.engine import Engine, EngineConfig
+
+    ec = EngineConfig(max_slots=8, max_context=256, prefill_buckets=(32,),
+                      decode_block=8, prompt_cache=False, mesh=mesh,
+                      kv_pages=kv_pages, cache_type=cache_type)
+    outs = _collect(Engine(cfg, params, None, ec), _reqs(cfg, 8))
+    assert sorted(outs) == list(range(8))
+    return outs
+
+
+def _parity(cfg, params, sharded, mesh4, **kw):
+    ref = _run_engine(cfg, params, None, **kw)
+    got = _run_engine(cfg, sharded, mesh4, **kw)
+    for i in ref:
+        assert got[i] == ref[i], f"slot {i} diverged under TP: " \
+                                 f"{ref[i]} vs {got[i]}"
+
+
+# Stream parity uses f32 activations: row-parallel wo/w_down split their
+# reduction across shards, and with bf16 activations the psum's reduction-
+# order rounding (~1e-2 relative) exceeds the smallest greedy top-2 logit
+# margins this model produces (~1e-3, measured over 16 steps for several
+# seeds) — bit-exact bf16 token streams vs a single device are a coin flip
+# by construction, not a property TP can promise. f32 noise is ~1e-7, three
+# orders under the margins, so these streams are deterministically stable;
+# the bf16 path is covered by the logits-closeness + full-stream test below.
+
+@pytest.fixture(scope="module")
+def f32_params():
+    return CFG, init_params(CFG, jax.random.PRNGKey(3))
+
+
+@pytest.fixture(scope="module")
+def int8_params(f32_params):
+    cfg, params = f32_params
+    return cfg, quantize_params(params, bits=8)
+
+
+def test_tp_parity_dense(f32_params, mesh4):
+    cfg, params = f32_params
+    sharded = shard_params(params, param_specs(cfg), mesh4)
+    _parity(cfg, params, sharded, mesh4)
+
+
+def test_tp_parity_dense_int8_w(int8_params, mesh4):
+    cfg, qparams = int8_params
+    sharded = shard_params(qparams, param_specs(cfg, qbits=8), mesh4)
+    _parity(cfg, qparams, sharded, mesh4)
+
+
+def test_tp_parity_paged(f32_params, mesh4):
+    cfg, params = f32_params
+    sharded = shard_params(params, param_specs(cfg), mesh4)
+    _parity(cfg, params, sharded, mesh4, kv_pages=16)
+
+
+def test_tp_parity_paged_int8_w_int8_kv(int8_params, mesh4):
+    """The full flagship recipe under TP: int8 weights + int8 paged KV."""
+    cfg, qparams = int8_params
+    sharded = shard_params(qparams, param_specs(cfg, qbits=8), mesh4)
+    _parity(cfg, qparams, sharded, mesh4, kv_pages=16, cache_type="int8")
+
+
+def test_tp_paged_pallas_scatter_via_shard_map(int8_params, mesh4,
+                                               monkeypatch):
+    """The Pallas scatter-append tier survives TP: with LOCALAI_FORCE_PALLAS
+    the paged decode write runs per-shard via shard_map over the pool's
+    KV-head axis and still reproduces the single-device stream."""
+    monkeypatch.setenv("LOCALAI_FORCE_PALLAS", "1")
+    cfg, qparams = int8_params
+    sharded = shard_params(qparams, param_specs(cfg, qbits=8), mesh4)
+    ref = _run_engine(cfg, qparams, None, kv_pages=16)
+    got = _run_engine(cfg, sharded, mesh4, kv_pages=16)
+    assert got == ref
+
+
+def test_tp_bf16_decode_close_and_streams_full(mesh4):
+    """The bf16 leg: one fused prefill+decode under TP must track the
+    single-device logits within bf16 rounding (the psum reduction-order
+    bound — see the parity note above), and the 8-slot TP engine must
+    produce complete streams on the bf16+int8-W flagship dtype."""
+    from functools import partial
+
+    cfg = dataclasses.replace(CFG, dtype="bfloat16")
+    params = init_params(cfg, jax.random.PRNGKey(3))
+    qparams = quantize_params(params, bits=8)
+    B, T = 8, 64
+    cos, sin = rope_table(cfg.rope, T)
+    rng = np.random.default_rng(7)
+    toks = jnp.asarray(rng.integers(5, cfg.vocab_size, (B, 6)), jnp.int32)
+    lengths = jnp.full((B,), 6, jnp.int32)
+
+    def run(ps, mesh):
+        kc, vc = init_kv_cache(cfg, B, T)
+        with activate_mesh(mesh):
+            logits, kc, vc = jax.jit(partial(prefill, cfg=cfg))(
+                ps, tokens=toks, lengths=lengths, cos=cos, sin=sin,
+                k_cache=kc, v_cache=vc, slot_map=jnp.arange(B))
+            nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+            dlogits, _, _ = jax.jit(partial(decode_step, cfg=cfg))(
+                ps, tokens=nxt, lengths=lengths, cos=cos, sin=sin,
+                k_cache=kc, v_cache=vc)
+        return np.asarray(logits), np.asarray(dlogits)
+
+    sharded = shard_params(qparams, param_specs(cfg, qbits=8), mesh4)
+    for ref, got in zip(run(qparams, None), run(sharded, mesh4)):
+        np.testing.assert_allclose(got, ref, rtol=0.05, atol=0.05)
+    # and the serving loop end to end: full-length streams at 8 slots
+    outs = _run_engine(cfg, sharded, mesh4, kv_pages=16, cache_type="int8")
+    assert all(len(v) == 14 for v in outs.values())
+
+
+# -------------------------------------------- compiled-step HLO inspection
+
+_SHAPE_RE = re.compile(r"\w+\[([\d,]*)\]")
+
+
+def _allgather_sizes(hlo_text: str) -> list[int]:
+    """Element counts of every all-gather result in an HLO dump."""
+    sizes = []
+    for line in hlo_text.splitlines():
+        if "all-gather" not in line:
+            continue
+        head = line.split("all-gather", 1)[0]
+        if "=" not in head:
+            continue
+        for dims in _SHAPE_RE.findall(head.split("=", 1)[1]):
+            n = 1
+            for d in filter(None, dims.split(",")):
+                n *= int(d)
+            sizes.append(n)
+    return sizes
+
+
+def _compiled_decode_step(mesh4, params, cfg):
+    from functools import partial
+
+    B, T = 8, 128
+    cos, sin = rope_table(cfg.rope, T)
+    kc, vc = init_kv_cache(cfg, B, T)
+    kv_sh = NamedSharding(mesh4, kv_cache_spec())
+    kc, vc = jax.device_put(kc, kv_sh), jax.device_put(vc, kv_sh)
+    tokens = jnp.zeros((B,), jnp.int32)
+    lengths = jnp.full((B,), 5, jnp.int32)
+    with activate_mesh(mesh4):
+        lowered = jax.jit(partial(decode_step, cfg=cfg)).lower(
+            params, tokens=tokens, lengths=lengths, cos=cos, sin=sin,
+            k_cache=kc, v_cache=vc)
+        return lowered.compile().as_text()
+
+
+def test_tp_decode_step_no_full_weight_allgather(mesh4):
+    """Acceptance: the compiled TP int8 decode step contains NO all-gather
+    at (or above) full-weight size — weights stay sharded through the layer
+    scan. The vocab-parallel logits gather ([B, V], small) is the only big
+    collective allowed besides the per-layer psum."""
+    qparams = quantize_params(init_params(CFG, jax.random.PRNGKey(0)))
+    sharded = shard_params(qparams, param_specs(CFG, qbits=8), mesh4)
+    txt = _compiled_decode_step(mesh4, sharded, CFG)
+    # smallest full projection: wk/wv layer slice [h, kvh*hd]
+    weight_elems = CFG.hidden_size * CFG.num_kv_heads * CFG.head_dim
+    big = [n for n in _allgather_sizes(txt) if n >= weight_elems]
+    assert not big, f"weight-sized all-gather in the TP decode step: {big}"
+    # ... and TP is actually active: the row-parallel psum is in there
+    assert "all-reduce" in txt, "no all-reduce — decode step not partitioned"
+
+
+def test_allgather_detector_not_vacuous(mesh4):
+    """The HLO parser DOES see a full-weight all-gather when one exists
+    (sharded weight forced back to replicated) — the assertion above has
+    teeth."""
+    w = jax.device_put(jnp.zeros((64, 256), jnp.float32),
+                       NamedSharding(mesh4, P(None, "model")))
+    txt = jax.jit(lambda a: a * 2.0,
+                  out_shardings=NamedSharding(mesh4, P(None, None))) \
+        .lower(w).compile().as_text()
+    assert any(n >= 64 * 256 for n in _allgather_sizes(txt)), \
+        f"detector missed the forced all-gather:\n{txt}"
+
+
+# -------------------------------------------------- plumbing + telemetry
+
+def test_cli_run_parses_tensor_parallel():
+    import argparse
+
+    from localai_tpu.cli import _add_run
+
+    parser = argparse.ArgumentParser()
+    _add_run(parser.add_subparsers(dest="cmd"))
+    args = parser.parse_args(["run", "--tensor-parallel", "4"])
+    assert args.tensor_parallel == 4
+
+
+def test_manager_plumbs_tensor_parallel_to_mesh_model():
+    """`--tensor-parallel N` reaches the backend as mesh_model=N unless the
+    model YAML pins its own mesh."""
+    from localai_tpu.config import AppConfig, ModelConfig
+    from localai_tpu.core.manager import ModelManager
+
+    class FakeClient:
+        def load_model(self, **kw):
+            self.kw = kw
+            return types.SimpleNamespace(success=True)
+
+    mgr = ModelManager.__new__(ModelManager)
+    mgr.app = AppConfig(tensor_parallel=4)
+    h = types.SimpleNamespace(client=FakeClient(),
+                              config=ModelConfig(name="m"))
+    mgr._load_rpc(h)
+    assert h.client.kw["mesh_model"] == 4
+    # explicit per-model mesh wins
+    h2 = types.SimpleNamespace(client=FakeClient(),
+                               config=ModelConfig.from_dict(
+                                   {"name": "m2", "mesh": {"model": 2}}))
+    mgr._load_rpc(h2)
+    assert h2.client.kw["mesh_model"] == 2
+
+
+def test_bench_parser_has_tp_mode():
+    import bench
+
+    p = bench.build_parser()
+    args = p.parse_args(["--mode", "tp", "--tensor-parallel", "2", "--cpu"])
+    assert args.mode == "tp" and args.tensor_parallel == 2
+
+
+def test_profiler_records_mesh_and_per_chip_mfu(mesh4):
+    """Telemetry acceptance: profiler artifacts carry the mesh shape and the
+    MFU denominator scales with the chip count, so a TP profile is never
+    silently read as a single-chip one."""
+    from localai_tpu.telemetry import StepProfiler
+
+    shape = mesh_shape(mesh4)
+    assert shape == {"data": 1, "model": 4}
+    prof = StepProfiler(fence=False, n_params=1000, peak=1e9, mesh=shape)
+    single = StepProfiler(fence=False, n_params=1000, peak=1e9)
+    import time
+
+    t0 = time.perf_counter() - 0.01
+    prof.record("decode", t0, tokens=100)
+    single.record("decode", t0, tokens=100)
+    rep, srep = prof.report(), single.report()
+    assert rep["mesh"] == {"data": 1, "model": 4} and rep["chips"] == 4
+    assert srep["mesh"] is None and srep["chips"] == 1
+    # same tokens, same wall time: per-chip-normalized MFU is 4x smaller
+    ratio = (srep["stages"]["decode"]["mfu"]
+             / rep["stages"]["decode"]["mfu"])
+    assert abs(ratio - 4.0) < 0.5
+
+
+def test_engine_profiler_inherits_engine_mesh(mesh4, monkeypatch):
+    from localai_tpu import telemetry
+
+    telemetry.set_profile_enabled(True)
+    try:
+        prof = telemetry.engine_profiler(CFG, mesh=mesh4)
+        assert prof is not None
+        assert prof.mesh == {"data": 1, "model": 4}
+        assert prof.chips == 4
+    finally:
+        telemetry.set_profile_enabled(None)
