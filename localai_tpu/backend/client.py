@@ -15,6 +15,7 @@ import grpc
 
 from localai_tpu import telemetry
 from localai_tpu.backend import pb
+from localai_tpu.core import resilience
 
 # gRPC metadata key carrying the HTTP request id into the backend process
 # (server/http.py middleware → here → backend/llm.py → GenRequest.trace_id)
@@ -45,12 +46,14 @@ class BackendClient:
             ("grpc.max_reconnect_backoff_ms", 2000),
         ])
         self._calls = {}
+        self._req_cls = {}
         sym = pb._pb2
         for m in pb.SERVICE.methods:
             req_cls = getattr(sym, m.input_type.name)
             resp_cls = getattr(sym, m.output_type.name)
             make = (self._channel.unary_stream if m.server_streaming
                     else self._channel.unary_unary)
+            self._req_cls[m.name] = req_cls
             self._calls[m.name] = make(
                 f"/{pb.SERVICE_NAME}/{m.name}",
                 request_serializer=req_cls.SerializeToString,
@@ -59,6 +62,50 @@ class BackendClient:
 
     def close(self):
         self._channel.close()
+
+    # ---------------------------------------------------- deadline plumbing
+
+    @staticmethod
+    def _timeout(default: float) -> float:
+        """Shrink an RPC timeout to the current request's remaining deadline
+        budget (core/resilience contextvar, minted by the HTTP middleware —
+        asyncio.to_thread copies the context into worker threads)."""
+        rem = resilience.deadline_remaining()
+        if rem is None:
+            return default
+        return max(min(default, rem), 0.001)
+
+    def _request(self, method: str, kw: dict):
+        """Build the request message; PredictOptions additionally carries the
+        remaining deadline in-band (deadline_ms) so the ENGINE can evict an
+        expired slot instead of decoding tokens nobody will read."""
+        cls = self._req_cls[method]
+        if cls is pb.PredictOptions and "deadline_ms" not in kw:
+            rem = resilience.deadline_remaining()
+            if rem is not None:
+                kw["deadline_ms"] = max(int(rem * 1000), 1)
+        return cls(**kw)
+
+    def start(self, method: str, timeout: float = 600.0, **kw):
+        """Begin a unary RPC and return its grpc Future — the cancellable
+        form the HTTP layer uses so a client disconnect can abort the call
+        (`fut.cancel()`) the way `call.cancel()` already works for streams."""
+        fut = self._calls[method].future(
+            self._request(method, kw), timeout=self._timeout(timeout),
+            metadata=_trace_md())
+        tr = telemetry.maybe_tracer()
+        if tr is not None:
+            # same rpc.<Method> span the blocking wrappers record, closed
+            # when the future settles (completion, error, or cancel). The
+            # request id is captured HERE — the done callback runs on a gRPC
+            # thread without this request's contextvars.
+            args = {"addr": self.addr}
+            rid = telemetry.current_request_id()
+            if rid:
+                args["request_id"] = rid
+            s = tr.begin(f"rpc.{method}", cat="rpc", args=args)
+            fut.add_done_callback(lambda _f: tr.finish(s))
+        return fut
 
     def __enter__(self):
         return self
@@ -94,8 +141,8 @@ class BackendClient:
 
     def predict(self, timeout: float = 600.0, **kw) -> "pb.Reply":
         with telemetry.span("rpc.Predict", cat="rpc", addr=self.addr):
-            return self._calls["Predict"](pb.PredictOptions(**kw),
-                                          timeout=timeout,
+            return self._calls["Predict"](self._request("Predict", kw),
+                                          timeout=self._timeout(timeout),
                                           metadata=_trace_md())
 
     def predict_stream(self, timeout: float = 600.0, **kw) -> Iterator["pb.Reply"]:
@@ -104,14 +151,15 @@ class BackendClient:
         # carries the full generation interval
         with telemetry.span("rpc.PredictStream.open", cat="rpc",
                             addr=self.addr):
-            return self._calls["PredictStream"](pb.PredictOptions(**kw),
-                                                timeout=timeout,
-                                                metadata=_trace_md())
+            return self._calls["PredictStream"](
+                self._request("PredictStream", kw),
+                timeout=self._timeout(timeout),
+                metadata=_trace_md())
 
     def embedding(self, timeout: float = 600.0, **kw) -> "pb.EmbeddingResult":
         with telemetry.span("rpc.Embedding", cat="rpc", addr=self.addr):
-            return self._calls["Embedding"](pb.PredictOptions(**kw),
-                                            timeout=timeout,
+            return self._calls["Embedding"](self._request("Embedding", kw),
+                                            timeout=self._timeout(timeout),
                                             metadata=_trace_md())
 
     def tokenize(self, prompt: str, timeout: float = 60.0) -> "pb.TokenizationResponse":

@@ -19,6 +19,18 @@ from localai_tpu.backend import pb
 from localai_tpu.backend.base import BackendServicer
 from localai_tpu.backend.client import REQUEST_ID_KEY
 from localai_tpu.ops.sampling import SamplingParams
+from localai_tpu.testing import faults
+
+
+def _inject_faults(context):
+    """Chaos-harness hooks (LOCALAI_FAULT): deterministic gRPC-status faults
+    on the generation path. No-ops (one env lookup) in normal serving."""
+    if faults.fire("unavailable") is not None:
+        context.abort(grpc.StatusCode.UNAVAILABLE,
+                      "injected UNAVAILABLE (LOCALAI_FAULT)")
+    if faults.fire("deadline") is not None:
+        context.abort(grpc.StatusCode.DEADLINE_EXCEEDED,
+                      "injected DEADLINE_EXCEEDED (LOCALAI_FAULT)")
 
 
 def _request_id(context) -> str:
@@ -347,11 +359,23 @@ class LLMServicer(BackendServicer):
             mm_positions=mm_positions,
             trace_id=trace_id,
             trace_parent=trace_parent,
+            # remaining HTTP-request budget → absolute engine deadline: an
+            # expired slot is evicted (finish "timeout") instead of decoding
+            # tokens nobody will read
+            deadline=(time.monotonic() + request.deadline_ms / 1e3
+                      if request.deadline_ms else 0.0),
         )
         try:
-            return self.engine.submit(req)
+            rid, out = self.engine.submit(req)
         except (ValueError, RuntimeError) as e:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        # RPC termination (client cancel/disconnect, deadline) evicts the
+        # slot — the unary analog of the stream's call.cancel() path. Fires
+        # on normal completion too, where cancel() is a no-op. (Direct
+        # servicer tests pass context=None.)
+        if context is not None:
+            context.add_callback(lambda: self.engine.cancel(rid))
+        return rid, out
 
     def _encode_images(self, ids, images):
         """b64 images + prompt ids with <image> placeholders → (expanded ids,
@@ -383,6 +407,7 @@ class LLMServicer(BackendServicer):
 
     def Predict(self, request, context):
         self._require_engine(context)
+        _inject_faults(context)
         t0 = time.monotonic()
         trace_id = _request_id(context)
         tr = telemetry.maybe_tracer()
@@ -418,6 +443,8 @@ class LLMServicer(BackendServicer):
 
     def PredictStream(self, request, context):
         self._require_engine(context)
+        _inject_faults(context)
+        stall = faults.fire("stall_stream")
         t0 = time.monotonic()
         trace_id = _request_id(context)
         tr = telemetry.maybe_tracer()
@@ -426,8 +453,17 @@ class LLMServicer(BackendServicer):
         rid, out = self._submit(request, context, trace_id=trace_id,
                                 trace_parent=gspan.sid if gspan else 0)
         ttft = 0.0
+        sent_text = False
         while True:
             o = out.get()
+            if sent_text and stall:
+                # stall-mid-stream fault: the first TEXT chunk went out (so
+                # the client has provably received bytes), then the backend
+                # wedges for `stall` seconds (chaos harness)
+                time.sleep(stall)
+                stall = None
+            if o.text:
+                sent_text = True
             if o.token_id >= 0 and not ttft:
                 ttft = time.monotonic() - t0
             yield pb.Reply(

@@ -39,6 +39,29 @@ def _add_run(sub):
                    help=".env file to load (default: ./.env, ./.env.local)")
     p.add_argument("--disable-config-watcher", action="store_true",
                    help="do not hot-reload model YAMLs on change")
+    # resilience knobs (ISSUE 4) — AppConfig fields, env LOCALAI_<NAME>
+    p.add_argument("--request-timeout", type=float, default=None,
+                   help="per-request deadline budget in seconds; propagated "
+                        "through gRPC into the engine so expired slots are "
+                        "evicted (default 600)")
+    p.add_argument("--retry-budget", type=int, default=None,
+                   help="transparent retries against a respawned backend "
+                        "when a request fails before any bytes streamed "
+                        "(default 1)")
+    p.add_argument("--breaker-threshold", type=int, default=None,
+                   help="consecutive backend failures before the circuit "
+                        "breaker opens and loads fail fast (default 3)")
+    p.add_argument("--breaker-cooldown", type=float, default=None,
+                   help="seconds a tripped breaker stays open before a "
+                        "half-open probe (default 15)")
+    p.add_argument("--queue-depth", type=int, default=None,
+                   help="per-model bounded wait queue beyond the in-flight "
+                        "limit; excess requests get 429 + Retry-After "
+                        "(default 8)")
+    p.add_argument("--drain-timeout", type=float, default=None,
+                   help="graceful-shutdown hard deadline: SIGTERM and "
+                        "/backend/shutdown let in-flight requests finish "
+                        "this long while new work gets 503 (default 30)")
     p.add_argument("--trace", action="store_true",
                    help="record request/engine spans (LOCALAI_TRACE=1); "
                         "export via /debug/trace or `util trace`")

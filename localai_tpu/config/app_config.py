@@ -28,6 +28,19 @@ class AppConfig:
     single_active_backend: bool = False
     watchdog_idle_timeout: float = 0.0   # seconds; 0 = disabled
     watchdog_busy_timeout: float = 0.0
+    # --- resilience knobs (ISSUE 4) ---
+    request_timeout: float = 600.0   # per-request deadline budget (s); the
+                                     # X-Request-Timeout header can lower it
+    retry_budget: int = 1            # supervised retries after the first
+                                     # attempt (dead/UNAVAILABLE backends)
+    breaker_threshold: int = 3       # consecutive failures → breaker opens
+    breaker_cooldown: float = 15.0   # seconds open before a half-open probe
+    queue_depth: int = 8             # per-model bounded wait queue; beyond
+                                     # in-flight+queue → 429 + Retry-After
+    drain_timeout: float = 30.0      # graceful-shutdown hard deadline (s)
+    spawn_retries: int = 2           # fresh-port respawns when the child
+                                     # dies before health (port TOCTOU)
+    spawn_timeout: float = 120.0     # health budget per spawn attempt (s)
     preload_models: list[str] = dataclasses.field(default_factory=list)
     log_level: str = "info"
     machine_tag: str = ""
@@ -42,7 +55,12 @@ class AppConfig:
         cfg = cls()
         for field, cast in [("address", str), ("models_path", str),
                             ("context_size", int), ("parallel_requests", int),
-                            ("tensor_parallel", int), ("machine_tag", str)]:
+                            ("tensor_parallel", int), ("machine_tag", str),
+                            ("request_timeout", float), ("retry_budget", int),
+                            ("breaker_threshold", int),
+                            ("breaker_cooldown", float),
+                            ("queue_depth", int), ("drain_timeout", float),
+                            ("spawn_retries", int), ("spawn_timeout", float)]:
             v = env(field.upper(), cast)
             if v is not None:
                 setattr(cfg, field, v)

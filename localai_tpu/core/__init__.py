@@ -1,1 +1,11 @@
-from localai_tpu.core.manager import ModelManager, BackendHandle  # noqa: F401
+# Lazy re-exports (PEP 562): backend.client imports core.resilience for
+# deadline propagation, and manager imports backend.client — an eager
+# manager import here would close that loop into a cycle.
+
+
+def __getattr__(name):
+    if name in ("ModelManager", "BackendHandle"):
+        from localai_tpu.core import manager
+
+        return getattr(manager, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

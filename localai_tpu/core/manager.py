@@ -5,9 +5,18 @@ The reference's L3 (/root/reference/pkg/model): mutex-guarded model map
 RPC (process.go:93-160, initializers.go:50-154), dead-process reap on cache
 hit (loader.go:191-225), busy/idle watchdog (watchdog.go:19-49), single-active
 -backend serialization (initializers.go:205-226).
+
+Resilience layer (ISSUE 4): loads serialize per MODEL (a 120 s spawn of model
+A no longer freezes model B), dead children are detected immediately and
+respawned on a fresh port (the free_port TOCTOU race), a per-backend circuit
+breaker stops respawn storms, and `supervised()` retries request-time
+UNAVAILABLE/dead-backend failures with capped backoff — translating watchdog
+reaps and breaker rejections into typed errors the HTTP layer maps to
+504/503.
 """
 from __future__ import annotations
 
+import collections
 import os
 import socket
 import subprocess
@@ -16,8 +25,15 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+import grpc
+
 from localai_tpu.backend.client import BackendClient
 from localai_tpu.config import AppConfig, ModelConfig
+from localai_tpu.core import resilience
+from localai_tpu.core.resilience import (
+    BackendUnavailable, CircuitBreaker, DeadlineExceeded, WatchdogReaped,
+    backoff,
+)
 
 
 def free_port() -> int:
@@ -26,6 +42,13 @@ def free_port() -> int:
     port = s.getsockname()[1]
     s.close()
     return port
+
+
+class SpawnCrashed(RuntimeError):
+    """The backend child exited before ever answering health — either it
+    crashed at startup or lost the free_port TOCTOU race (another process
+    bound the port between close() and the child's bind). Retriable on a
+    fresh port without burning the whole health budget."""
 
 
 @dataclass
@@ -38,10 +61,18 @@ class BackendHandle:
     busy: int = 0                 # in-flight requests
     last_used: float = field(default_factory=time.monotonic)
     busy_since: float = 0.0
+    poisoned: str = ""            # terminal reason stamped by the reaper —
+                                  # in-flight requests that now fail their
+                                  # RPC surface THIS instead of a raw
+                                  # severed-channel grpc error
     _lock: threading.Lock = field(default_factory=threading.Lock)
 
     def alive(self) -> bool:
         return self.proc.poll() is None
+
+    def poison(self, reason: str):
+        if reason and not self.poisoned:
+            self.poisoned = reason
 
     def mark_busy(self):
         with self._lock:
@@ -62,13 +93,35 @@ class ModelManager:
     def __init__(self, app: AppConfig):
         self.app = app
         self._models: dict[str, BackendHandle] = {}
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()          # guards the maps only — never
+                                               # held across spawn/health/RPC
+        self._model_locks: dict[str, threading.Lock] = {}
+        self._breakers: dict[str, CircuitBreaker] = {}
+        # supervision telemetry: (model, event) → count, scraped into the
+        # localai_backend_supervision_total Prometheus gauge
+        self.events: collections.Counter = collections.Counter()
         self._watchdog: threading.Thread | None = None
         self._stop = threading.Event()
 
+    def _model_lock(self, name: str) -> threading.Lock:
+        with self._lock:
+            lk = self._model_locks.get(name)
+            if lk is None:
+                lk = self._model_locks[name] = threading.Lock()
+            return lk
+
+    def breaker(self, name: str) -> CircuitBreaker:
+        with self._lock:
+            br = self._breakers.get(name)
+            if br is None:
+                br = self._breakers[name] = CircuitBreaker(
+                    threshold=getattr(self.app, "breaker_threshold", 3),
+                    cooldown=getattr(self.app, "breaker_cooldown", 15.0))
+            return br
+
     # ------------------------------------------------------------ spawn/load
 
-    def _spawn(self, cfg: ModelConfig) -> BackendHandle:
+    def _spawn_once(self, cfg: ModelConfig) -> BackendHandle:
         port = free_port()
         env = dict(os.environ)
         # child must import localai_tpu regardless of the parent's cwd, and
@@ -79,6 +132,9 @@ class ModelManager:
         parts = [pkg_root] + [
             p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p]
         env["PYTHONPATH"] = os.pathsep.join(dict.fromkeys(parts))
+        # chaos-harness targeting: fault specs may scope to one model name
+        # (localai_tpu/testing/faults.py) — stamp the child so they can
+        env["LOCALAI_FAULT_MODEL"] = cfg.name
         # gallery-installed external backend? its run.sh owns the process
         # (reference initializers.go:50-99 — external backends launch from
         # the backends dir); in-tree roles spawn the python module
@@ -110,11 +166,44 @@ class ModelManager:
         threading.Thread(target=self._tail, args=(cfg.name, proc),
                          daemon=True).start()
         client = BackendClient(f"127.0.0.1:{port}")
-        if not client.wait_ready(attempts=240, sleep=0.5):
+        budget = getattr(self.app, "spawn_timeout", 120.0) or 120.0
+        deadline = time.monotonic() + budget
+        ready = False
+        while time.monotonic() < deadline:
+            if client.health(timeout=2.0, wait=True):
+                ready = True
+                break
+            if proc.poll() is not None:
+                # dead child: don't sit out the rest of the health budget —
+                # either a startup crash or the port TOCTOU race; the caller
+                # retries on a fresh port
+                client.close()
+                raise SpawnCrashed(
+                    f"backend for {cfg.name} exited rc={proc.returncode} "
+                    f"before becoming healthy (port {port})")
+            time.sleep(0.25)
+        if not ready:
+            client.close()
             proc.terminate()
-            raise RuntimeError(f"backend for {cfg.name} never became healthy")
+            raise RuntimeError(
+                f"backend for {cfg.name} never became healthy "
+                f"within {budget:.0f}s")
         return BackendHandle(name=cfg.name, config=cfg, proc=proc,
                              client=client, port=port)
+
+    def _spawn(self, cfg: ModelConfig) -> BackendHandle:
+        """Spawn with fresh-port retries when the child dies before health —
+        a crashing backend fails in seconds, not spawn_timeout."""
+        retries = max(0, getattr(self.app, "spawn_retries", 2))
+        last: Exception | None = None
+        for attempt in range(retries + 1):
+            try:
+                return self._spawn_once(cfg)
+            except SpawnCrashed as e:
+                last = e
+                if attempt < retries:
+                    self.events[(cfg.name, "spawn_retry")] += 1
+        raise last
 
     @staticmethod
     def _tail(name: str, proc: subprocess.Popen):
@@ -153,24 +242,51 @@ class ModelManager:
 
     def load(self, cfg: ModelConfig) -> BackendHandle:
         """Get-or-start the backend for a model config. Health-rechecks cached
-        processes and reaps+respawns dead ones (loader.go:191-225)."""
-        with self._lock:
-            h = self._models.get(cfg.name)
+        processes and reaps+respawns dead ones (loader.go:191-225).
+
+        Serialization is per model: concurrent loads of the SAME model share
+        one spawn; a load of model B proceeds while model A is mid-spawn
+        (the seed held one global lock through the whole 120 s health wait).
+        The circuit breaker fails fast once a model has proven broken."""
+        h = self.get(cfg.name)
+        if h is not None and h.alive() and h.client.health(timeout=5.0):
+            h.last_used = time.monotonic()
+            return h
+        br = self.breaker(cfg.name)
+        if not br.allow():
+            self.events[(cfg.name, "breaker_reject")] += 1
+            raise BackendUnavailable(
+                f"circuit breaker open for {cfg.name!r} after repeated "
+                f"backend failures; next probe in {br.retry_after():.1f}s",
+                retry_after=max(br.retry_after(), 0.1))
+        with self._model_lock(cfg.name):
+            # somebody may have finished the same load while we waited
+            h = self.get(cfg.name)
             if h is not None:
                 if h.alive() and h.client.health(timeout=5.0):
                     h.last_used = time.monotonic()
+                    br.record_success()
                     return h
-                self._reap_locked(h)
+                self._reap(h, reason="dead backend found at load")
+                self.events[(cfg.name, "reap_dead")] += 1
             if self.app.single_active_backend:
-                for other in list(self._models.values()):
-                    self._reap_locked(other)
-            h = self._spawn(cfg)
+                with self._lock:
+                    others = [o for o in self._models.values()
+                              if o.name != cfg.name]
+                for other in others:
+                    self._reap(other, reason="single_active_backend")
+            h = None
             try:
+                h = self._spawn(cfg)
                 self._load_rpc(h)
             except Exception:
-                self._reap_locked(h)
+                br.record_failure()
+                if h is not None:
+                    self._reap(h, reason="load failed")
                 raise
-            self._models[cfg.name] = h
+            br.record_success()
+            with self._lock:
+                self._models[cfg.name] = h
             return h
 
     def get(self, name: str) -> BackendHandle | None:
@@ -181,8 +297,13 @@ class ModelManager:
         with self._lock:
             return sorted(self._models)
 
-    def _reap_locked(self, h: BackendHandle):
-        self._models.pop(h.name, None)
+    def _reap(self, h: BackendHandle, reason: str = ""):
+        """Remove (if current) + terminate one backend. Safe to call from any
+        thread; never holds the map lock across the process wait."""
+        with self._lock:
+            if self._models.get(h.name) is h:
+                del self._models[h.name]
+        h.poison(reason)
         h.client.close()
         if h.alive():
             h.proc.terminate()
@@ -192,18 +313,110 @@ class ModelManager:
                 h.proc.kill()  # forced-shutdown escape hatch (process.go:29-43)
 
     def stop_model(self, name: str) -> bool:
-        with self._lock:
-            h = self._models.get(name)
-            if h is None:
-                return False
-            self._reap_locked(h)
-            return True
+        h = self.get(name)
+        if h is None:
+            return False
+        self._reap(h, reason="stopped by request")
+        return True
+
+    def drain_model(self, name: str, timeout: float = 30.0) -> bool:
+        """Graceful stop: wait for the backend's in-flight requests to finish
+        (up to `timeout`), then reap — instead of severing mid-generation."""
+        h = self.get(name)
+        if h is None:
+            return False
+        deadline = time.monotonic() + max(timeout, 0.0)
+        while h.busy > 0 and time.monotonic() < deadline and h.alive():
+            time.sleep(0.05)
+        self._reap(h, reason="drained for shutdown")
+        return True
 
     def stop_all(self):
         self._stop.set()
         with self._lock:
-            for h in list(self._models.values()):
-                self._reap_locked(h)
+            handles = list(self._models.values())
+        for h in handles:
+            self._reap(h, reason="server shutdown")
+
+    # ------------------------------------------------------------ supervision
+
+    def classify_failure(self, handle: BackendHandle,
+                         exc: Exception) -> tuple[bool, Exception]:
+        """Turn a request-time failure into (retriable?, translated error).
+
+        Poisoned handle (watchdog/shutdown reap) → the reap reason as a 504,
+        never retried: the reaper acted deliberately and a retry would just
+        stall again. Dead process → reap + retriable 503 (the next load()
+        respawns). Live backend returning UNAVAILABLE → retriable 503.
+        Everything else passes through untranslated."""
+        code = exc.code() if isinstance(exc, grpc.RpcError) else None
+        if handle.poisoned:
+            return False, WatchdogReaped(
+                f"backend for {handle.name!r} was reaped mid-request "
+                f"({handle.poisoned})")
+        dead = not handle.alive()
+        if not dead and code == grpc.StatusCode.UNAVAILABLE:
+            # a severed channel can surface UNAVAILABLE before the child's
+            # death is observable (Popen.poll even reports None while
+            # another thread holds the wait lock) — give the process table
+            # a grace beat before classifying the backend as alive
+            deadline = time.monotonic() + 0.5
+            while not dead and time.monotonic() < deadline:
+                time.sleep(0.05)
+                dead = not handle.alive()
+        if dead:
+            self._reap(handle, reason="died mid-request")
+            self.events[(handle.name, "died_midrequest")] += 1
+            return True, BackendUnavailable(
+                f"backend for {handle.name!r} died mid-request "
+                f"(rc={handle.proc.returncode})")
+        if code == grpc.StatusCode.UNAVAILABLE:
+            self.events[(handle.name, "unavailable_alive")] += 1
+            self.breaker(handle.name).record_failure()
+            return True, BackendUnavailable(
+                f"backend for {handle.name!r} unavailable: "
+                f"{exc.details() if hasattr(exc, 'details') else exc}")
+        if code == grpc.StatusCode.DEADLINE_EXCEEDED:
+            return False, DeadlineExceeded(
+                f"backend call for {handle.name!r} exceeded the request "
+                f"deadline")
+        return False, exc
+
+    def supervised(self, cfg: ModelConfig, op, *, retries: int | None = None):
+        """Run `op(handle)` against a live backend, transparently respawning
+        and retrying on dead/UNAVAILABLE backends with capped exponential
+        backoff — the request-time half of backend supervision. Only safe
+        for calls that have produced no client-visible bytes yet (unary RPCs
+        and stream OPENS; the HTTP stream bridge enforces the no-bytes rule
+        for streams). Busy accounting is owned here: every attempt is
+        mark_busy/try/finally mark_idle."""
+        if retries is None:
+            retries = max(0, getattr(self.app, "retry_budget", 1))
+        last: Exception | None = None
+        for attempt in range(retries + 1):
+            if attempt:
+                time.sleep(backoff(attempt))
+            rem = resilience.deadline_remaining()
+            if rem is not None and rem <= 0:
+                # the budget died (possibly mid-retry): a 504 tells the
+                # client the truth — their deadline ran out — regardless of
+                # what the last backend failure looked like
+                raise DeadlineExceeded(
+                    "request deadline exhausted before the backend call"
+                    + (f" (last failure: {last})" if last else "")) from last
+            handle = self.load(cfg)
+            handle.mark_busy()
+            try:
+                return op(handle)
+            except grpc.RpcError as e:
+                retriable, err = self.classify_failure(handle, e)
+                if not retriable or attempt >= retries:
+                    raise err from e
+                self.events[(cfg.name, "request_retry")] += 1
+                last = err
+            finally:
+                handle.mark_idle()
+        raise last  # pragma: no cover - loop always returns or raises
 
     # ------------------------------------------------------------ watchdog
 
@@ -222,14 +435,21 @@ class ModelManager:
         while not self._stop.wait(interval):
             now = time.monotonic()
             with self._lock:
-                for h in list(self._models.values()):
-                    if (busy_t and h.busy > 0
-                            and now - h.busy_since > busy_t):
-                        print(f"[watchdog] {h.name} busy > {busy_t}s — reaping",
-                              flush=True)
-                        self._reap_locked(h)
-                    elif (idle_t and h.busy == 0
-                            and now - h.last_used > idle_t):
-                        print(f"[watchdog] {h.name} idle > {idle_t}s — reaping",
-                              flush=True)
-                        self._reap_locked(h)
+                handles = list(self._models.values())
+            for h in handles:
+                if (busy_t and h.busy > 0
+                        and now - h.busy_since > busy_t):
+                    print(f"[watchdog] {h.name} busy > {busy_t}s — reaping",
+                          flush=True)
+                    self.events[(h.name, "watchdog_busy_reap")] += 1
+                    # poison BEFORE the channel dies so in-flight requests
+                    # fail with the watchdog named, not a raw RpcError
+                    self._reap(h, reason=f"busy-watchdog: backend busy "
+                                         f"longer than {busy_t:.0f}s")
+                elif (idle_t and h.busy == 0
+                        and now - h.last_used > idle_t):
+                    print(f"[watchdog] {h.name} idle > {idle_t}s — reaping",
+                          flush=True)
+                    self.events[(h.name, "watchdog_idle_reap")] += 1
+                    self._reap(h, reason=f"idle-watchdog: backend idle "
+                                         f"longer than {idle_t:.0f}s")

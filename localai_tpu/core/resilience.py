@@ -1,0 +1,159 @@
+"""Fault-tolerance primitives shared by the serving stack (ISSUE 4).
+
+Three small pieces, deliberately dependency-free so every layer can import
+them:
+
+- `CircuitBreaker`: closed/open/half-open per-backend (and, in federation,
+  per-worker) failure gate — stops respawn storms when a model is genuinely
+  broken instead of hammering a crashing subprocess in a tight loop.
+- deadline propagation: a per-request budget minted by the HTTP middleware
+  lives in a contextvar (asyncio.to_thread copies the context, same as the
+  request-id propagation in telemetry/trace.py), so the gRPC client can
+  shrink its timeouts to the remaining budget and ship it to the engine.
+- typed serving errors carrying an HTTP status + Retry-After hint, so the
+  middleware can translate supervisor/admission failures into the right
+  client-visible responses (429/503/504) instead of a raw 500.
+"""
+from __future__ import annotations
+
+import contextvars
+import threading
+import time
+
+
+# --------------------------------------------------------------- errors
+
+class ResilienceError(RuntimeError):
+    """Base for serving-path failures with a definite HTTP translation."""
+    status = 500
+    retry_after: float | None = None
+
+    def __init__(self, message: str, retry_after: float | None = None):
+        super().__init__(message)
+        if retry_after is not None:
+            self.retry_after = retry_after
+
+
+class BackendUnavailable(ResilienceError):
+    """Backend dead / unreachable / circuit broken — retriable later (503)."""
+    status = 503
+    retry_after = 1.0
+
+
+class WatchdogReaped(ResilienceError):
+    """The busy-watchdog deliberately killed the backend serving this
+    request — a gateway-timeout, not a generic RPC failure (504)."""
+    status = 504
+
+
+class DeadlineExceeded(ResilienceError):
+    """The request's deadline budget ran out (504)."""
+    status = 504
+
+
+class RequestShed(ResilienceError):
+    """Admission control refused the request: in-flight + wait queue full
+    (429) or the server is draining (503). `model`/`reason` feed the
+    localai_shed_total counter."""
+    status = 429
+    retry_after = 1.0
+
+    def __init__(self, message: str, model: str = "", reason: str = "",
+                 status: int = 429, retry_after: float | None = None):
+        super().__init__(message, retry_after=retry_after)
+        self.model = model
+        self.reason = reason
+        self.status = status
+
+
+# --------------------------------------------------------------- breaker
+
+class CircuitBreaker:
+    """Per-backend closed → open → half-open failure gate.
+
+    closed: requests flow; `threshold` consecutive failures trip it open.
+    open: `allow()` is False (fail fast) until `cooldown` elapses.
+    half-open: the next caller(s) probe the backend; one success closes the
+    breaker, one failure re-opens it for another cooldown. The half-open
+    admit is deliberately not single-flighted — a raced extra probe costs
+    one RPC, while a probe token lost to a crashed caller would wedge the
+    breaker open forever.
+    """
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+    def __init__(self, threshold: int = 3, cooldown: float = 15.0,
+                 clock=time.monotonic):
+        self.threshold = max(1, int(threshold))
+        self.cooldown = float(cooldown)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = self.CLOSED
+        self._failures = 0
+        self._opened_at = 0.0
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            if (self._state == self.OPEN
+                    and self._clock() - self._opened_at >= self.cooldown):
+                self._state = self.HALF_OPEN
+            return self._state
+
+    def allow(self) -> bool:
+        return self.state != self.OPEN
+
+    def retry_after(self) -> float:
+        """Seconds until the next half-open probe would be admitted."""
+        with self._lock:
+            if self._state != self.OPEN:
+                return 0.0
+            return max(0.0, self.cooldown - (self._clock() - self._opened_at))
+
+    def record_success(self):
+        with self._lock:
+            self._state = self.CLOSED
+            self._failures = 0
+
+    def record_failure(self):
+        with self._lock:
+            self._failures += 1
+            if self._state == self.HALF_OPEN or \
+                    self._failures >= self.threshold:
+                self._state = self.OPEN
+                self._opened_at = self._clock()
+
+
+def backoff(attempt: int, base: float = 0.25, cap: float = 2.0) -> float:
+    """Capped exponential backoff delay for retry `attempt` (1-based)."""
+    return min(base * (2 ** max(attempt - 1, 0)), cap)
+
+
+# --------------------------------------------------------------- deadline
+
+# absolute time.monotonic() instant the current request's budget expires;
+# None = no deadline bound (non-request contexts, tests)
+_deadline: contextvars.ContextVar[float | None] = contextvars.ContextVar(
+    "localai_deadline", default=None)
+
+
+def set_deadline(budget_s: float):
+    """Bind the current context to `now + budget_s`; returns the reset
+    token. Call from the HTTP middleware only — everything downstream
+    (thread pool included: to_thread copies the context) reads it."""
+    return _deadline.set(time.monotonic() + budget_s)
+
+
+def reset_deadline(token):
+    _deadline.reset(token)
+
+
+def deadline_remaining() -> float | None:
+    """Seconds left in this request's budget (may be <= 0), or None."""
+    d = _deadline.get()
+    return None if d is None else d - time.monotonic()
+
+
+def deadline_expired() -> bool:
+    rem = deadline_remaining()
+    return rem is not None and rem <= 0

@@ -123,6 +123,11 @@ class GenRequest:
     trace_id: str = ""            # request id propagated from the HTTP layer
                                   # (telemetry span correlation; "" = untraced)
     trace_parent: int = 0         # parent span id (the gRPC handler's span)
+    deadline: float = 0.0         # absolute time.monotonic() the request's
+                                  # budget expires (PredictOptions.deadline_ms
+                                  # via the HTTP middleware); the engine
+                                  # evicts the slot with finish "timeout"
+                                  # instead of decoding past it. 0 = none.
     # multimodal (models/llava.py): projected image features [K, H] f32 and
     # the prompt positions they occupy (the expanded image-token slots) —
     # injected into prefill instead of token embeddings
@@ -263,6 +268,12 @@ class Engine:
         self._inflight_steps = 0         # step count of the pending dispatch
         self._queue: "queue.Queue[tuple[int, GenRequest, queue.Queue]]" = queue.Queue()
         self._next_id = 0
+        # request ids marked for eviction by cancel() (client disconnect /
+        # gRPC termination). Written from handler threads under _lock; the
+        # loop thread reads bare — set membership is atomic under the GIL,
+        # and a one-tick-late observation only costs one extra token.
+        self._cancelled: set[int] = set()
+        self._live: set[int] = set()   # rids submitted but not yet terminal
         self._lock = threading.Lock()
         self._grammar_lock = threading.Lock()
         self._wake = threading.Event()
@@ -983,10 +994,27 @@ class Engine:
         with self._lock:
             rid = self._next_id
             self._next_id += 1
+            self._live.add(rid)
         out: queue.Queue = queue.Queue()
         self._queue.put((rid, req, out))
         self._wake.set()
         return rid, out
+
+    def cancel(self, rid: int):
+        """Mark a submitted request for eviction: its slot finishes with
+        reason "cancelled" at the next token (queued requests terminate at
+        admission). Safe from any thread; unknown/finished rids are no-ops —
+        gRPC termination callbacks fire on NORMAL completion too."""
+        with self._lock:
+            if rid in self._live:
+                self._cancelled.add(rid)
+        self._wake.set()
+
+    def _finish_rid(self, rid: int):
+        """A terminal StepOutput went out for `rid` — drop its bookkeeping."""
+        with self._lock:
+            self._live.discard(rid)
+            self._cancelled.discard(rid)
 
     # ------------------------------------------------------------ the loop
 
@@ -1025,6 +1053,7 @@ class Engine:
             chunked = n > self._small_max
             bucket = None if chunked else self._bucket(n)
         except Exception:
+            self._finish_rid(rid)
             out.put(StepOutput(
                 request_id=rid, text="", token_id=-1,
                 logprob=0.0, finished=True, finish_reason="error",
@@ -1213,6 +1242,18 @@ class Engine:
                     rid, req, out = self._queue.get_nowait()
                 except queue.Empty:
                     return
+            # dead-on-arrival requests (deadline spent waiting in the queue,
+            # or cancelled before admission) terminate here — never paying
+            # a prefill whose output nobody will read
+            if (rid in self._cancelled
+                    or (req.deadline and time.monotonic() > req.deadline)):
+                reason = "cancelled" if rid in self._cancelled else "timeout"
+                self._finish_rid(rid)
+                out.put(StepOutput(
+                    request_id=rid, text="", token_id=-1, logprob=0.0,
+                    finished=True, finish_reason=reason,
+                    prompt_tokens=len(req.prompt_ids)))
+                continue
             # keep the popped triple reachable while the device call runs:
             # if admission dies mid-flight, _fail_active must still
             # terminate this stream (it is in neither _queue nor _slots)
@@ -1519,6 +1560,14 @@ class Engine:
                 shift = True
             else:
                 finish = "length"
+        # eviction (ISSUE 4): a cancelled request (client gone — gRPC
+        # termination callback) or an expired deadline stops consuming decode
+        # lanes at the next emitted token instead of running to max_tokens
+        if finish is None and slot.request_id in self._cancelled:
+            finish = "cancelled"
+        elif finish is None and slot.req.deadline \
+                and now > slot.req.deadline:
+            finish = "timeout"
 
         # grammar: validate + advance the PDA BEFORE mutating anything, so a
         # stale-mask rejection leaves the slot exactly at its accepted prefix
@@ -1903,6 +1952,7 @@ class Engine:
                 slot.req.prompt_cache_path, exc_info=True)
 
     def _release_slot(self, idx: int, slot: _Slot):
+        self._finish_rid(slot.request_id)
         if slot.span is not None and self._tracer is not None:
             ttft_ms = ((slot.first_token_time - slot.start_time) * 1e3
                        if slot.first_token_time is not None else None)
@@ -2005,6 +2055,7 @@ class Engine:
         if self._deferred is not None:
             rid, req, out = self._deferred
             self._deferred = None
+            self._finish_rid(rid)
             out.put(StepOutput(request_id=rid, text="", token_id=-1,
                                logprob=0.0, finished=True,
                                finish_reason=reason))
@@ -2012,6 +2063,7 @@ class Engine:
             rid, req, out = self._admitting
             self._admitting = None
             if rid not in failed_rids:  # died before reaching a slot
+                self._finish_rid(rid)
                 out.put(StepOutput(request_id=rid, text="", token_id=-1,
                                    logprob=0.0, finished=True,
                                    finish_reason=reason))
@@ -2029,6 +2081,7 @@ class Engine:
                 rid, req, out = self._queue.get_nowait()
             except queue.Empty:
                 break
+            self._finish_rid(rid)
             out.put(StepOutput(request_id=rid, text="", token_id=-1,
                                logprob=0.0, finished=True,
                                finish_reason=reason))

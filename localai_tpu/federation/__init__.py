@@ -22,14 +22,24 @@ import time
 import aiohttp
 from aiohttp import web
 
+from localai_tpu.core.resilience import CircuitBreaker
+
 
 class Worker:
-    def __init__(self, url: str):
+    """One upstream replica. The circuit breaker (core/resilience — the same
+    class guarding backend subprocesses) stops the LB from re-probing a
+    flapping worker on every request: after `threshold` failures it is
+    skipped outright until the cooldown elapses."""
+
+    def __init__(self, url: str, breaker_threshold: int = 3,
+                 breaker_cooldown: float = 10.0):
         self.url = url.rstrip("/")
         self.in_flight = 0
         self.total = 0
         self.healthy = True
         self.last_check = 0.0
+        self.breaker = CircuitBreaker(threshold=breaker_threshold,
+                                      cooldown=breaker_cooldown)
 
 
 class FederatedServer:
@@ -56,7 +66,12 @@ class FederatedServer:
     # ------------------------------------------------------------ selection
 
     def pick(self) -> Worker | None:
-        live = [w for w in self.workers if w.healthy] or self.workers
+        live = [w for w in self.workers
+                if w.healthy and w.breaker.allow()]
+        # all breakers open / all unhealthy: half-open probes re-admit
+        # workers after their cooldown; until then, any worker beats none
+        live = live or [w for w in self.workers if w.breaker.allow()] \
+            or self.workers
         if not live:
             return None
         if self.strategy == "random":
@@ -96,7 +111,7 @@ class FederatedServer:
             raise web.HTTPUnauthorized(text="federation token required")
         return web.json_response([{
             "url": w.url, "healthy": w.healthy, "in_flight": w.in_flight,
-            "total": w.total,
+            "total": w.total, "breaker": w.breaker.state,
         } for w in self.workers])
 
     async def _proxy(self, request: web.Request):
@@ -146,9 +161,11 @@ class FederatedServer:
                     async for chunk in r.content.iter_chunked(16384):
                         await resp.write(chunk)
                     await resp.write_eof()
+                    w.breaker.record_success()
                     return resp
             except Exception as e:
                 w.healthy = False
+                w.breaker.record_failure()
                 last_error = e
             finally:
                 w.in_flight -= 1

@@ -14,15 +14,18 @@ event loop.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import json
 import os
 import threading
 import time
 
+import grpc
 from aiohttp import web
 
 from localai_tpu import telemetry
 from localai_tpu.config import AppConfig, ModelConfig, ModelConfigLoader
+from localai_tpu.core import resilience
 from localai_tpu.core.manager import ModelManager
 from localai_tpu.server import schema
 
@@ -46,6 +49,15 @@ try:
     _STAGE_TOK_S = Gauge(
         "localai_engine_stage_tokens_per_second",
         "Tokens/s through each engine stage", ["model", "stage"])
+    # load shedding (ISSUE 4): every 429/503 the admission layer or the
+    # drain path produces is counted here so shedding is observable
+    _SHED = Counter("localai_shed_total",
+                    "Requests shed by admission control or drain",
+                    ["model", "reason"])
+    # backend supervision events (spawn retries, respawns, watchdog reaps,
+    # breaker rejections) — refreshed from ModelManager.events at scrape
+    _SUPERVISION = Gauge("localai_backend_supervision_total",
+                         "Backend supervision events", ["model", "event"])
     _HAVE_PROM = True
 except Exception:  # pragma: no cover - prometheus_client is in the image
     _HAVE_PROM = False
@@ -90,6 +102,17 @@ def _fetch_image(url: str) -> str:
         raise ValueError(f"image at {host!r} exceeds "
                          f"{_IMAGE_FETCH_LIMIT >> 20} MiB")
     return base64.b64encode(data).decode()
+
+
+class _AdmissionGate:
+    """Per-model admission state: `limit` concurrent requests against the
+    backend plus at most `depth` waiters; the rest shed with 429."""
+
+    def __init__(self, limit: int, depth: int):
+        self.limit = max(1, int(limit))
+        self.depth = max(0, int(depth))
+        self.sem = asyncio.Semaphore(self.limit)
+        self.waiting = 0
 
 
 class API:
@@ -164,6 +187,15 @@ class API:
         self.backend_gallery_service = None  # ditto (backend registry)
         self._mcp_sessions: dict[str, list] = {}   # model → MCP sessions
         self._mcp_lock = threading.Lock()
+        # resilience state (ISSUE 4): per-model admission gates, the drain
+        # flag the middleware turns into 503s, and the live-request count
+        # graceful shutdown waits on
+        self._gates: dict[str, _AdmissionGate] = {}
+        self._draining = False
+        self._inflight = 0
+        # SIGTERM → web.run_app GracefulExit → runner.cleanup → here:
+        # drain in-flight work instead of reaping backends mid-generation
+        self.app.on_shutdown.append(self._on_shutdown)
 
     # ------------------------------------------------------------ middleware
 
@@ -193,6 +225,11 @@ class API:
         # client's x-localai-request-id metadata → backend → engine spans
         rid = request.headers.get("X-Request-Id") or telemetry.new_request_id()
         rid_token = telemetry.set_request_id(rid)
+        # work requests are counted for graceful drain and carry a deadline
+        # budget; /backend/shutdown stays admitted (it DRIVES the drain)
+        counted = (request.path not in _OPEN_PATHS
+                   and request.path != "/backend/shutdown")
+        dl_token = None
         try:
             if self.cfg.api_keys and request.path not in _OPEN_PATHS:
                 auth = request.headers.get("Authorization", "")
@@ -204,7 +241,39 @@ class API:
                         schema.error_body("invalid api key",
                                           "authentication_error", 401),
                         status=401)
-            resp = await handler(request)
+            if self._draining and counted:
+                # graceful shutdown in progress: shed new work loudly so the
+                # LB moves on, while in-flight requests finish
+                status = 503
+                if _HAVE_PROM:
+                    _SHED.labels("-", "draining").inc()
+                return web.json_response(
+                    schema.error_body("server is draining; retry elsewhere",
+                                      "server_error", 503),
+                    status=503, headers={"Retry-After": "1",
+                                         "X-Request-Id": rid})
+            # per-request deadline budget (ISSUE 4): middleware-minted,
+            # contextvar-carried — the gRPC client shrinks its timeouts to
+            # the remainder and ships it in-band so the engine can evict an
+            # expired slot. X-Request-Timeout may only LOWER the app bound.
+            budget = float(getattr(self.cfg, "request_timeout", 600.0) or 0)
+            hdr = request.headers.get("X-Request-Timeout", "")
+            if hdr:
+                try:
+                    v = float(hdr)
+                    if v > 0:
+                        budget = min(budget, v) if budget else v
+                except ValueError:
+                    pass
+            if counted and budget > 0:
+                dl_token = resilience.set_deadline(budget)
+            if counted:
+                self._inflight += 1
+            try:
+                resp = await handler(request)
+            finally:
+                if counted:
+                    self._inflight -= 1
             status = resp.status
             if self.cfg.machine_tag:  # fleet tracking (app.go:93-100)
                 resp.headers["Machine-Tag"] = self.cfg.machine_tag
@@ -214,6 +283,33 @@ class API:
             status = e.status
             e.headers["X-Request-Id"] = rid
             raise
+        except resilience.ResilienceError as e:
+            # typed serving failures (supervisor, breaker, admission,
+            # deadline) carry their own HTTP translation + Retry-After
+            status = e.status
+            if _HAVE_PROM and isinstance(e, resilience.RequestShed):
+                _SHED.labels(e.model or "-", e.reason or "overload").inc()
+            headers = {"X-Request-Id": rid}
+            if e.retry_after:
+                headers["Retry-After"] = str(max(int(e.retry_after + 0.999),
+                                                 1))
+            kind = {429: "overloaded_error", 503: "server_error",
+                    504: "timeout_error"}.get(status, "server_error")
+            return web.json_response(
+                schema.error_body(str(e), kind, status),
+                status=status, headers=headers)
+        except grpc.RpcError as e:
+            # untranslated gRPC stragglers: deadline → 504, severed/refused
+            # channel → 502 (the supervisor normally converts these first)
+            code = e.code() if hasattr(e, "code") else None
+            status = {grpc.StatusCode.DEADLINE_EXCEEDED: 504,
+                      grpc.StatusCode.UNAVAILABLE: 502,
+                      grpc.StatusCode.INVALID_ARGUMENT: 400,
+                      grpc.StatusCode.CANCELLED: 499}.get(code, 500)
+            return web.json_response(
+                schema.error_body(f"backend rpc failed: {code}",
+                                  "server_error", status),
+                status=status, headers={"X-Request-Id": rid})
         except Exception as e:
             status = 500
             return web.json_response(
@@ -221,6 +317,8 @@ class API:
                                   500), status=500,
                 headers={"X-Request-Id": rid})
         finally:
+            if dl_token is not None:
+                resilience.reset_deadline(dl_token)
             tr = telemetry.maybe_tracer()
             if tr is not None and request.path not in _OPEN_PATHS:
                 tr.add_complete(f"http {request.path}", t0, cat="http",
@@ -248,11 +346,72 @@ class API:
     async def _handle(self, cfg: ModelConfig):
         try:
             return await asyncio.to_thread(self.manager.load, cfg)
+        except resilience.ResilienceError:
+            raise   # middleware translates (503 + Retry-After etc.)
         except Exception as e:
             raise web.HTTPInternalServerError(
                 text=json.dumps(schema.error_body(
                     f"backend load failed: {e}", "server_error", 500)),
                 content_type="application/json")
+
+    def _gate(self, cfg: ModelConfig) -> "_AdmissionGate":
+        g = self._gates.get(cfg.name)
+        if g is None:
+            g = self._gates[cfg.name] = _AdmissionGate(
+                cfg.parallel or self.cfg.parallel_requests,
+                getattr(self.cfg, "queue_depth", 8))
+        return g
+
+    @contextlib.asynccontextmanager
+    async def _admit(self, cfg: ModelConfig):
+        """Admission control (ISSUE 4): bounded per-model in-flight plus a
+        small bounded wait queue; past that, fail FAST with 429 +
+        Retry-After (counted in localai_shed_total) instead of stacking
+        unbounded work on an overloaded engine."""
+        gate = self._gate(cfg)
+        if gate.sem.locked() and gate.waiting >= gate.depth:
+            raise resilience.RequestShed(
+                f"model {cfg.name!r} is at capacity "
+                f"({gate.limit} in flight, {gate.waiting} queued)",
+                model=cfg.name, reason="queue_full", retry_after=1.0)
+        gate.waiting += 1
+        try:
+            rem = resilience.deadline_remaining()
+            try:
+                await asyncio.wait_for(gate.sem.acquire(), timeout=rem)
+            except (asyncio.TimeoutError, TimeoutError):
+                raise resilience.RequestShed(
+                    f"model {cfg.name!r}: request deadline expired while "
+                    f"queued for a slot",
+                    model=cfg.name, reason="queue_timeout", retry_after=1.0)
+        finally:
+            gate.waiting -= 1
+        try:
+            yield
+        finally:
+            gate.sem.release()
+
+    async def _unary(self, cfg: ModelConfig, method: str,
+                     timeout: float = 600.0, **kw):
+        """Supervised, cancellable unary RPC against `cfg`'s backend: the
+        manager retries dead/UNAVAILABLE backends (respawning under the
+        circuit breaker) since no bytes have reached the client yet, and a
+        client disconnect cancels the in-flight RPC — the unary analog of
+        the stream path's call.cancel()."""
+        box: dict = {}
+
+        def op(handle):
+            fut = handle.client.start(method, timeout=timeout, **kw)
+            box["fut"] = fut
+            return fut.result()
+
+        try:
+            return await asyncio.to_thread(self.manager.supervised, cfg, op)
+        except asyncio.CancelledError:
+            fut = box.get("fut")
+            if fut is not None:
+                fut.cancel()
+            raise
 
     def _merged_options(self, cfg: ModelConfig, body: dict) -> dict:
         """request JSON > model YAML defaults (request.go:118-211)."""
@@ -289,7 +448,35 @@ class API:
             opts["logprobs"] = True
         return opts
 
-    async def _stream_rpc(self, handle, opts: dict):
+    async def _stream_rpc(self, cfg: ModelConfig, opts: dict):
+        """Supervised streaming call: attempts that fail before ANY chunk
+        reached the client retry transparently on a (re)spawned backend with
+        capped backoff; once bytes have streamed, the failure surfaces —
+        translated (watchdog reap → 504-style message, dead backend → 503)
+        — for the SSE loop to emit as a terminal error event. Each attempt
+        brackets its own busy accounting."""
+        retries = max(0, getattr(self.cfg, "retry_budget", 1))
+        for attempt in range(retries + 1):
+            if attempt:
+                await asyncio.sleep(resilience.backoff(attempt))
+            handle = await self._handle(cfg)
+            handle.mark_busy()
+            streamed = False
+            try:
+                async for reply in self._pump_stream(handle, opts):
+                    streamed = True
+                    yield reply
+                return
+            except grpc.RpcError as e:
+                retriable, err = await asyncio.to_thread(
+                    self.manager.classify_failure, handle, e)
+                if streamed or not retriable or attempt >= retries:
+                    raise err from e
+                self.manager.events[(cfg.name, "stream_retry")] += 1
+            finally:
+                handle.mark_idle()
+
+    async def _pump_stream(self, handle, opts: dict):
         """Bridge the blocking gRPC stream into an async queue."""
         loop = asyncio.get_running_loop()
         # Bounded queue + BLOCKING put from the pump thread: backpressure
@@ -364,6 +551,8 @@ class API:
         """Pull each loaded backend's prof_* metrics into the Prometheus
         stage gauges (best-effort — a wedged backend must not fail the
         scrape, and profile-less runs simply publish nothing)."""
+        for (model, event), n in list(self.manager.events.items()):
+            _SUPERVISION.labels(model, event).set(n)
         for name in self.manager.loaded():
             h = self.manager.get(name)
             if h is None:
@@ -482,7 +671,6 @@ class API:
             raise web.HTTPBadRequest(
                 text=json.dumps(schema.error_body(f"bad image: {e}")),
                 content_type="application/json")
-        handle = await self._handle(cfg)
         opts = self._merged_options(cfg, body)
         if images:
             opts["images"] = images
@@ -503,14 +691,12 @@ class API:
         tools_active = (bool(body.get("tools"))
                         and body.get("tool_choice") != "none"
                         and not body.get("response_format"))
-        handle.mark_busy()
-        try:
+        async with self._admit(cfg):
             if body.get("stream"):
-                return await self._chat_stream(request, cfg, handle, opts,
+                return await self._chat_stream(request, cfg, opts,
                                                tools_active=tools_active,
                                                body=body)
-            reply = await asyncio.to_thread(
-                lambda: handle.client.predict(**opts))
+            reply = await self._unary(cfg, "Predict", **opts)
             text = reply.message.decode("utf-8", "replace")
             tool_calls = None
             if tools_active:
@@ -536,15 +722,30 @@ class API:
                 reply.timing_prompt_processing,
                 reply.timing_token_generation)
             return web.json_response(resp)
-        finally:
-            handle.mark_idle()
 
-    async def _chat_stream(self, request, cfg, handle, opts,
+    async def _sse_error(self, resp, send, e: Exception):
+        """Mid-stream failure → a clean terminal SSE error event + [DONE]
+        (never a silently hung or truncated connection — ISSUE 4). Best
+        effort: the client itself may already be gone."""
+        status = getattr(e, "status", 500)
+        kind = {429: "overloaded_error", 503: "server_error",
+                504: "timeout_error"}.get(status, "server_error")
+        try:
+            await send(schema.error_body(f"{e}", kind, status))
+            await resp.write(b"data: [DONE]\n\n")
+            await resp.write_eof()
+        except (ConnectionError, RuntimeError):
+            pass
+        return resp
+
+    async def _chat_stream(self, request, cfg, opts,
                            tools_active: bool = False, body: dict | None = None):
         """SSE loop (reference chat.go:334-449): role chunk, deltas, usage
         chunk, data: [DONE]. With tools active the output is buffered (it is
         a grammar-constrained JSON object, meaningless as partial text) and
         emitted as one tool_calls delta, finish_reason "tool_calls"."""
+        # load failures before any SSE bytes surface as plain HTTP errors
+        await self._handle(cfg)
         resp = web.StreamResponse(headers={
             "Content-Type": "text/event-stream",
             "Cache-Control": "no-cache",
@@ -561,19 +762,24 @@ class API:
         t_prompt = t_gen = 0.0
         finish = "stop"
         buffered: list[str] = []
-        async for reply in self._stream_rpc(handle, opts):
-            prompt_tokens = reply.prompt_tokens
-            completion_tokens = reply.tokens
-            t_prompt = reply.timing_prompt_processing or t_prompt
-            t_gen = reply.timing_token_generation or t_gen
-            text = reply.message.decode("utf-8", "replace")
-            if text:
-                if tools_active:
-                    buffered.append(text)
-                else:
-                    await send(schema.chat_chunk(rid, cfg.name, text))
-            if reply.finish_reason:
-                finish = reply.finish_reason
+        try:
+            async for reply in self._stream_rpc(cfg, opts):
+                prompt_tokens = reply.prompt_tokens
+                completion_tokens = reply.tokens
+                t_prompt = reply.timing_prompt_processing or t_prompt
+                t_gen = reply.timing_token_generation or t_gen
+                text = reply.message.decode("utf-8", "replace")
+                if text:
+                    if tools_active:
+                        buffered.append(text)
+                    else:
+                        await send(schema.chat_chunk(rid, cfg.name, text))
+                if reply.finish_reason:
+                    finish = reply.finish_reason
+        except (asyncio.CancelledError, ConnectionError):
+            raise          # client went away — nothing left to tell it
+        except Exception as e:
+            return await self._sse_error(resp, send, e)
         if tools_active:
             from localai_tpu.functions import parse_tool_response
 
@@ -611,7 +817,6 @@ class API:
         prompt = body.get("prompt") or ""
         if isinstance(prompt, list):
             prompt = prompt[0] if prompt else ""
-        handle = await self._handle(cfg)
         opts = self._merged_options(cfg, body)
         if cfg.template.completion:
             from localai_tpu.templates import evaluate_completion
@@ -619,12 +824,10 @@ class API:
             prompt = evaluate_completion(cfg, prompt)
         opts["prompt"] = prompt
 
-        handle.mark_busy()
-        try:
+        async with self._admit(cfg):
             if body.get("stream"):
-                return await self._completion_stream(request, cfg, handle, opts)
-            reply = await asyncio.to_thread(
-                lambda: handle.client.predict(**opts))
+                return await self._completion_stream(request, cfg, opts)
+            reply = await self._unary(cfg, "Predict", **opts)
             out = schema.text_completion(
                 cfg.name, reply.message.decode("utf-8", "replace"),
                 reply.finish_reason, reply.prompt_tokens, reply.tokens)
@@ -633,10 +836,9 @@ class API:
                 reply.timing_prompt_processing,
                 reply.timing_token_generation)
             return web.json_response(out)
-        finally:
-            handle.mark_idle()
 
-    async def _completion_stream(self, request, cfg, handle, opts):
+    async def _completion_stream(self, request, cfg, opts):
+        await self._handle(cfg)   # load errors stay plain HTTP, not SSE
         resp = web.StreamResponse(headers={
             "Content-Type": "text/event-stream",
             "Cache-Control": "no-cache",
@@ -646,17 +848,26 @@ class API:
         finish = "stop"
         prompt_tokens = completion_tokens = 0
         t_prompt = t_gen = 0.0
-        async for reply in self._stream_rpc(handle, opts):
-            text = reply.message.decode("utf-8", "replace")
-            prompt_tokens = reply.prompt_tokens
-            completion_tokens = reply.tokens
-            t_prompt = reply.timing_prompt_processing or t_prompt
-            t_gen = reply.timing_token_generation or t_gen
-            if reply.finish_reason:
-                finish = reply.finish_reason
-            if text:
-                await resp.write(
-                    f"data: {json.dumps(schema.text_completion_chunk(rid, cfg.name, text))}\n\n".encode())
+
+        async def send(obj):
+            await resp.write(f"data: {json.dumps(obj)}\n\n".encode())
+
+        try:
+            async for reply in self._stream_rpc(cfg, opts):
+                text = reply.message.decode("utf-8", "replace")
+                prompt_tokens = reply.prompt_tokens
+                completion_tokens = reply.tokens
+                t_prompt = reply.timing_prompt_processing or t_prompt
+                t_gen = reply.timing_token_generation or t_gen
+                if reply.finish_reason:
+                    finish = reply.finish_reason
+                if text:
+                    await send(schema.text_completion_chunk(rid, cfg.name,
+                                                            text))
+        except (asyncio.CancelledError, ConnectionError):
+            raise
+        except Exception as e:
+            return await self._sse_error(resp, send, e)
         final = schema.text_completion_chunk(rid, cfg.name, "", finish)
         if request.headers.get("Extra-Usage"):
             # reference completion.go:74 parity on the stream too
@@ -674,30 +885,22 @@ class API:
         inputs = body.get("input") or ""
         if isinstance(inputs, str):
             inputs = [inputs]
-        handle = await self._handle(cfg)
-
-        handle.mark_busy()
-        try:
+        async with self._admit(cfg):
             # ONE RPC for the whole batch → one bucketed device call
             # (a batch-256 request used to make 512 round trips)
-            r = await asyncio.to_thread(
-                lambda: handle.client.embedding(prompts=inputs))
+            r = await self._unary(cfg, "Embedding", prompts=inputs)
             vectors = [list(v.values) for v in r.vectors]
             return web.json_response(schema.embeddings_response(
                 cfg.name, vectors, r.prompt_tokens))
-        finally:
-            handle.mark_idle()
 
     async def _rerank(self, request):
         body = await request.json()
         cfg = self._resolve(body)
-        handle = await self._handle(cfg)
-        handle.mark_busy()
-        try:
-            r = await asyncio.to_thread(lambda: handle.client.rerank(
-                query=body.get("query", ""),
-                documents=body.get("documents", []),
-                top_n=body.get("top_n", 0)))
+        async with self._admit(cfg):
+            r = await self._unary(cfg, "Rerank",
+                                  query=body.get("query", ""),
+                                  documents=body.get("documents", []),
+                                  top_n=body.get("top_n", 0))
             return web.json_response({
                 "model": cfg.name,
                 "results": [{
@@ -706,8 +909,6 @@ class API:
                     "document": {"text": d.text},
                 } for d in r.results],
             })
-        finally:
-            handle.mark_idle()
 
     async def _edits(self, request):
         """POST /v1/edits — legacy OpenAI edit API (reference
@@ -922,8 +1123,12 @@ class API:
         body = await request.json()
         cfg = self._resolve(body)
         handle = await self._handle(cfg)
-        t = await asyncio.to_thread(
-            lambda: handle.client.tokenize(body.get("content", "")))
+        handle.mark_busy()
+        try:
+            t = await asyncio.to_thread(
+                lambda: handle.client.tokenize(body.get("content", "")))
+        finally:
+            handle.mark_idle()
         return web.json_response({"tokens": list(t.tokens)})
 
     async def _backend_monitor(self, request):
@@ -949,10 +1154,40 @@ class API:
         return web.json_response(out)
 
     async def _backend_shutdown(self, request):
-        body = await request.json()
-        ok = await asyncio.to_thread(
-            self.manager.stop_model, body.get("model", ""))
-        return web.json_response({"success": ok})
+        """POST /backend/shutdown — graceful (ISSUE 4). With {"model": x}:
+        drain that backend's in-flight requests (up to drain_timeout) then
+        reap it. Without a model: server-wide drain — new work 503s while
+        in-flight requests finish under the hard deadline, then every
+        backend stops."""
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        timeout = float(body.get("timeout",
+                                 getattr(self.cfg, "drain_timeout", 30.0)))
+        model = body.get("model", "")
+        if model:
+            ok = await asyncio.to_thread(
+                self.manager.drain_model, model, timeout)
+            return web.json_response({"success": ok})
+        await self._drain(timeout)
+        return web.json_response({"success": True, "draining": True})
+
+    async def _drain(self, timeout: float):
+        """Reject new work (middleware 503s while self._draining), wait for
+        in-flight requests to finish — hard deadline — then stop backends."""
+        self._draining = True
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + max(timeout, 0.0)
+        while self._inflight > 0 and loop.time() < deadline:
+            await asyncio.sleep(0.05)
+        await asyncio.to_thread(self.manager.stop_all)
+
+    async def _on_shutdown(self, app):
+        # SIGTERM/cleanup path: drain unless an explicit /backend/shutdown
+        # already did
+        if not self._draining:
+            await self._drain(getattr(self.cfg, "drain_timeout", 30.0))
 
     async def _realtime(self, request):
         from localai_tpu.server.realtime import realtime_handler
@@ -1132,8 +1367,12 @@ class API:
         if cfg is None:
             cfg = ModelConfig(name=name, backend="tts")
         handle = await self._handle(cfg)
-        r = await asyncio.to_thread(
-            lambda: handle.client.vad(body.get("audio", [])))
+        handle.mark_busy()
+        try:
+            r = await asyncio.to_thread(
+                lambda: handle.client.vad(body.get("audio", [])))
+        finally:
+            handle.mark_idle()
         return web.json_response({"segments": [
             {"start": s.start, "end": s.end} for s in r.segments]})
 
@@ -1178,16 +1417,24 @@ class API:
     async def _stores_set(self, request):
         body = await request.json()
         h = await self._store_handle(body)
-        await asyncio.to_thread(lambda: h.client.stores_set(
-            body.get("keys", []),
-            [v.encode() for v in body.get("values", [])]))
+        h.mark_busy()
+        try:
+            await asyncio.to_thread(lambda: h.client.stores_set(
+                body.get("keys", []),
+                [v.encode() for v in body.get("values", [])]))
+        finally:
+            h.mark_idle()
         return web.json_response({})
 
     async def _stores_get(self, request):
         body = await request.json()
         h = await self._store_handle(body)
-        r = await asyncio.to_thread(
-            lambda: h.client.stores_get(body.get("keys", [])))
+        h.mark_busy()
+        try:
+            r = await asyncio.to_thread(
+                lambda: h.client.stores_get(body.get("keys", [])))
+        finally:
+            h.mark_idle()
         return web.json_response({
             "keys": [list(k.floats) for k in r.keys],
             "values": [v.bytes.decode("utf-8", "replace") for v in r.values],
@@ -1196,15 +1443,23 @@ class API:
     async def _stores_delete(self, request):
         body = await request.json()
         h = await self._store_handle(body)
-        await asyncio.to_thread(
-            lambda: h.client.stores_delete(body.get("keys", [])))
+        h.mark_busy()
+        try:
+            await asyncio.to_thread(
+                lambda: h.client.stores_delete(body.get("keys", [])))
+        finally:
+            h.mark_idle()
         return web.json_response({})
 
     async def _stores_find(self, request):
         body = await request.json()
         h = await self._store_handle(body)
-        r = await asyncio.to_thread(lambda: h.client.stores_find(
-            body.get("key", []), int(body.get("topk", 10))))
+        h.mark_busy()
+        try:
+            r = await asyncio.to_thread(lambda: h.client.stores_find(
+                body.get("key", []), int(body.get("topk", 10))))
+        finally:
+            h.mark_idle()
         return web.json_response({
             "keys": [list(k.floats) for k in r.keys],
             "values": [v.bytes.decode("utf-8", "replace") for v in r.values],
@@ -1337,6 +1592,12 @@ def run_server(args) -> int:
         tensor_parallel=getattr(args, "tensor_parallel", None),
         single_active_backend=getattr(args, "single_active_backend", None),
         api_keys=getattr(args, "api_keys", None),
+        request_timeout=getattr(args, "request_timeout", None),
+        retry_budget=getattr(args, "retry_budget", None),
+        breaker_threshold=getattr(args, "breaker_threshold", None),
+        breaker_cooldown=getattr(args, "breaker_cooldown", None),
+        queue_depth=getattr(args, "queue_depth", None),
+        drain_timeout=getattr(args, "drain_timeout", None),
     )
     for t in ("watchdog_idle_timeout", "watchdog_busy_timeout"):
         v = getattr(args, t, None)

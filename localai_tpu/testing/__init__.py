@@ -1,0 +1,9 @@
+"""Test-support package: deterministic fault injection (`testing.faults`).
+
+Shipped inside the package (not under tests/) because the injection points
+live in production modules — the backend entrypoint and the LLM servicer
+call `faults.fire(...)` at their hazard points, and those calls must resolve
+in spawned subprocesses too. With `LOCALAI_FAULT` unset every hook is a
+single dict lookup returning None.
+"""
+from localai_tpu.testing import faults  # noqa: F401
