@@ -413,23 +413,28 @@ class LLMServicer(BackendServicer):
         tr = telemetry.maybe_tracer()
         gspan = tr.begin("grpc.Predict", cat="grpc",
                          args={"request_id": trace_id}) if tr else None
-        rid, out = self._submit(request, context, trace_id=trace_id,
-                                trace_parent=gspan.sid if gspan else 0)
         text, ids, logprobs, ttft = [], [], [], 0.0
         o = None
-        while True:
-            o = out.get()
-            if o.token_id >= 0 and not ttft:
-                ttft = time.monotonic() - t0
-            if o.text:
-                text.append(o.text)
-            if o.token_id >= 0:
-                ids.append(o.token_id)
-                logprobs.append(o.logprob)
-            if o.finished:
-                break
-        if gspan is not None:
-            tr.finish(gspan, tokens=o.generated_tokens, ttft_s=ttft)
+        try:
+            rid, out = self._submit(request, context, trace_id=trace_id,
+                                    trace_parent=gspan.sid if gspan else 0)
+            while True:
+                o = out.get()
+                if o.token_id >= 0 and not ttft:
+                    ttft = time.monotonic() - t0
+                if o.text:
+                    text.append(o.text)
+                if o.token_id >= 0:
+                    ids.append(o.token_id)
+                    logprobs.append(o.logprob)
+                if o.finished:
+                    break
+        finally:
+            # a _submit abort / severed stream must still close the span, or
+            # the request's trace never reaches the ring buffer
+            if gspan is not None:
+                tr.finish(gspan, tokens=o.generated_tokens if o else 0,
+                          ttft_s=ttft)
         return pb.Reply(
             message="".join(text).encode(),
             tokens=o.generated_tokens,
@@ -450,37 +455,44 @@ class LLMServicer(BackendServicer):
         tr = telemetry.maybe_tracer()
         gspan = tr.begin("grpc.PredictStream", cat="grpc",
                          args={"request_id": trace_id}) if tr else None
-        rid, out = self._submit(request, context, trace_id=trace_id,
-                                trace_parent=gspan.sid if gspan else 0)
         ttft = 0.0
         sent_text = False
-        while True:
-            o = out.get()
-            if sent_text and stall:
-                # stall-mid-stream fault: the first TEXT chunk went out (so
-                # the client has provably received bytes), then the backend
-                # wedges for `stall` seconds (chaos harness)
-                time.sleep(stall)
-                stall = None
-            if o.text:
-                sent_text = True
-            if o.token_id >= 0 and not ttft:
-                ttft = time.monotonic() - t0
-            yield pb.Reply(
-                message=o.text.encode(),
-                tokens=o.generated_tokens,
-                prompt_tokens=o.prompt_tokens,
-                timing_prompt_processing=ttft if o.finished else 0.0,
-                timing_token_generation=(time.monotonic() - t0 - ttft)
-                if o.finished else 0.0,
-                logprobs=[o.logprob] if request.logprobs and o.token_id >= 0 else [],
-                token_ids=[o.token_id] if o.token_id >= 0 else [],
-                finish_reason=o.finish_reason or "",
-            )
-            if o.finished:
-                if gspan is not None:
-                    tr.finish(gspan, tokens=o.generated_tokens, ttft_s=ttft)
-                return
+        o = None
+        try:
+            rid, out = self._submit(request, context, trace_id=trace_id,
+                                    trace_parent=gspan.sid if gspan else 0)
+            while True:
+                o = out.get()
+                if sent_text and stall:
+                    # stall-mid-stream fault: the first TEXT chunk went out
+                    # (so the client has provably received bytes), then the
+                    # backend wedges for `stall` seconds (chaos harness)
+                    time.sleep(stall)
+                    stall = None
+                if o.text:
+                    sent_text = True
+                if o.token_id >= 0 and not ttft:
+                    ttft = time.monotonic() - t0
+                yield pb.Reply(
+                    message=o.text.encode(),
+                    tokens=o.generated_tokens,
+                    prompt_tokens=o.prompt_tokens,
+                    timing_prompt_processing=ttft if o.finished else 0.0,
+                    timing_token_generation=(time.monotonic() - t0 - ttft)
+                    if o.finished else 0.0,
+                    logprobs=[o.logprob]
+                    if request.logprobs and o.token_id >= 0 else [],
+                    token_ids=[o.token_id] if o.token_id >= 0 else [],
+                    finish_reason=o.finish_reason or "",
+                )
+                if o.finished:
+                    return
+        finally:
+            # client disconnects mid-stream (GeneratorExit) and _submit
+            # aborts land here too — the span must always close
+            if gspan is not None:
+                tr.finish(gspan, tokens=o.generated_tokens if o else 0,
+                          ttft_s=ttft)
 
     # ------------------------------------------------------------ aux RPCs
 

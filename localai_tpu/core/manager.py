@@ -263,6 +263,9 @@ class ModelManager:
             # somebody may have finished the same load while we waited
             h = self.get(cfg.name)
             if h is not None:
+                # lint: allow(lock-across-blocking) — the per-MODEL lock is
+                # the load-serialization point by design (PR 4): it blocks
+                # only same-model loads; the map lock is never held here
                 if h.alive() and h.client.health(timeout=5.0):
                     h.last_used = time.monotonic()
                     br.record_success()

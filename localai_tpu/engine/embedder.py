@@ -25,7 +25,10 @@ class Embedder:
         self.buckets = tuple(sorted(b for b in buckets
                                     if b <= cfg.max_position)) or (64,)
         self.mesh = mesh
-        self._fn = jax.jit(partial(encode_pooled, cfg=cfg))
+        # normalize is a Python `if` inside the trace — keep it static so a
+        # live-bool caller can't hit a TracerBoolConversionError
+        self._fn = jax.jit(partial(encode_pooled, cfg=cfg),
+                           static_argnames=("normalize",))
 
     def _bucket(self, n: int) -> int:
         for b in self.buckets:

@@ -25,6 +25,7 @@ tokens/sec + TTFT metrics (GetMetrics parity —
 """
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import queue
 import os
@@ -308,6 +309,14 @@ class Engine:
 
         self._prof = telemetry.engine_profiler(cfg, mesh=self.mesh)
         self._tracer = telemetry.maybe_tracer()
+
+        # runtime tripwire (localai_tpu/testing/tripwires): with
+        # LOCALAI_TRANSFER_GUARD set, every decode dispatch runs under
+        # jax.transfer_guard(level) — an implicit host transfer inside the
+        # fused block raises instead of silently stalling the pipeline
+        from localai_tpu.testing.tripwires import decode_guard_level
+
+        self._xfer_guard = decode_guard_level()
 
         self._build_jit()
 
@@ -654,6 +663,13 @@ class Engine:
         the single source of truth with no donation bookkeeping."""
         return jnp.asarray(self._table) if self._paged else None
 
+    def _decode_guard(self):
+        """Transfer-guard context for the decode dispatch (nullcontext unless
+        LOCALAI_TRANSFER_GUARD is set — see testing/tripwires)."""
+        if self._xfer_guard:
+            return jax.transfer_guard(self._xfer_guard)
+        return contextlib.nullcontext()
+
     def _obs(self, stage: str, t0: float, tokens: int = 0, fence=None,
              **args):
         """Record one device-dispatch observation (telemetry subsystem).
@@ -767,7 +783,7 @@ class Engine:
         self._bcast("decode", active=active,
                     mask=None if mask_host is None else mask_host,
                     fast_width=fast_width)
-        with activate_mesh(self.mesh):
+        with activate_mesh(self.mesh), self._decode_guard():
             args = (self.params, self._cos, self._sin,
                     self._kc, self._vc, self._sampler, self._last_logits,
                     self._lengths, jnp.asarray(active))
@@ -796,7 +812,7 @@ class Engine:
         self._bcast("decode_block", active=active, steps=steps,
                     fast_width=fast_width,
                     mask=None if mask_host is None else mask_host)
-        with activate_mesh(self.mesh):
+        with activate_mesh(self.mesh), self._decode_guard():
             args = (self.params, self._cos, self._sin,
                     self._kc, self._vc, self._sampler, self._last_logits,
                     self._lengths, jnp.asarray(active))
@@ -853,6 +869,8 @@ class Engine:
             tok, lp, self._sampler = self._spec_admit_tail_fn(
                 self._sampler, self._last_logits, jnp.int32(idx))
             self._next_tokens = self._next_tokens.at[idx].set(tok)
+        # lint: allow(host-sync-cast) — spec invariant: the admission-sampled
+        # first token must be emitted NOW (one sync per request, not per step)
         return int(tok), float(lp)
 
     def _dev_spec_decode(self, active):
@@ -1464,7 +1482,7 @@ class Engine:
         buf = np.zeros((1, self._chunk), np.int32)
         buf[0, 0] = seq[-1]
         seed = (slot.request_id * 1000003 + slot.generated) & 0x7FFFFFFF
-        key = np.asarray(jax.random.key_data(
+        key = jax.device_get(jax.random.key_data(
             jax.random.PRNGKey(seed))).astype(np.uint32)
         row = dict(slot.row, key=key)
         slot.row = row

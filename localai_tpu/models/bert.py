@@ -243,4 +243,7 @@ class BertEmbedder(_Embedder):
         self.buckets = tuple(sorted(b for b in buckets if b <= top)) or (
             min(64, top),)
         self.mesh = mesh
-        self._fn = jax.jit(partial(bert_pooled, cfg=cfg))
+        # normalize is branched on in Python inside the trace — static, so a
+        # caller passing it as a live bool can't hit a TracerBoolConversion
+        self._fn = jax.jit(partial(bert_pooled, cfg=cfg),
+                           static_argnames=("normalize",))

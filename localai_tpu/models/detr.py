@@ -472,8 +472,8 @@ class Detector:
         img = Image.open(src)
         pixels, sx, sy = self._preprocess(img)
         logits, boxes = self._fn(self.params, pixels=jnp.asarray(pixels))
-        probs = np.asarray(jax.nn.softmax(logits, axis=-1))[0, :, :-1]
-        boxes = np.asarray(boxes)[0]
+        probs = jax.device_get(jax.nn.softmax(logits, axis=-1))[0, :, :-1]
+        boxes = jax.device_get(boxes)[0]
         out = []
         for qi in range(probs.shape[0]):
             ci = int(np.argmax(probs[qi]))

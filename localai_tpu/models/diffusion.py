@@ -268,7 +268,7 @@ class DiffusionModel:
 
         img = self._sample(self.params, tokens=self._tokens(prompt),
                            steps=steps, seed=seed)
-        arr = np.asarray(img[0] * 255.0, np.uint8)
+        arr = jax.device_get(img[0] * 255.0).astype(np.uint8)
         Image.fromarray(arr).resize((width, height),
                                     Image.BILINEAR).save(dst)
         return dst
@@ -284,7 +284,7 @@ class DiffusionModel:
         for f in range(num_frames):
             img = self._sample(self.params, tokens=self._tokens(prompt),
                                steps=steps, seed=seed + f)
-            arr = np.asarray(img[0] * 255.0, np.uint8)
+            arr = jax.device_get(img[0] * 255.0).astype(np.uint8)
             frames.append(Image.fromarray(arr).resize((width, height),
                                                       Image.BILINEAR))
         frames[0].save(dst, save_all=True, append_images=frames[1:],

@@ -612,7 +612,7 @@ def synthesize_ids(p, cfg: VitsConfig, ids: np.ndarray, *,
     else:
         log_dur = plain_log_duration(p["dp"], cfg, hidden, mask)
 
-    dur = np.asarray(jnp.ceil(jnp.exp(log_dur) * mask / rate))[0, 0]
+    dur = jax.device_get(jnp.ceil(jnp.exp(log_dur) * mask / rate))[0, 0]
 
     # length regulator: repeat each input index dur[i] times
     reps = dur.astype(np.int64)

@@ -28,6 +28,9 @@ def build_and_load(name: str) -> ctypes.CDLL:
                 or os.path.getmtime(lib) < os.path.getmtime(src)):
             cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
                    "-o", lib + ".tmp", src]
+            # lint: allow(lock-across-blocking) — one-time lazy build: the
+            # lock MUST cover the compile so concurrent importers don't race
+            # the .so; no request path runs before load
             r = subprocess.run(cmd, capture_output=True, text=True)
             if r.returncode != 0:
                 raise RuntimeError(f"native build of {name} failed:\n{r.stderr}")
@@ -39,6 +42,8 @@ def build_and_load(name: str) -> ctypes.CDLL:
             # rebuild from source for THIS platform and retry once
             cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
                    "-o", lib + ".tmp", src]
+            # lint: allow(lock-across-blocking) — same one-time build lock
+            # as above (stale/foreign-arch rebuild retry)
             r = subprocess.run(cmd, capture_output=True, text=True)
             if r.returncode != 0:
                 raise RuntimeError(

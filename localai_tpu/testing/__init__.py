@@ -1,9 +1,12 @@
-"""Test-support package: deterministic fault injection (`testing.faults`).
+"""Test-support package: deterministic fault injection (`testing.faults`)
+and the runtime perf tripwires (`testing.tripwires`).
 
 Shipped inside the package (not under tests/) because the injection points
 live in production modules — the backend entrypoint and the LLM servicer
-call `faults.fire(...)` at their hazard points, and those calls must resolve
-in spawned subprocesses too. With `LOCALAI_FAULT` unset every hook is a
-single dict lookup returning None.
+call `faults.fire(...)` at their hazard points, the engine reads
+`tripwires.decode_guard_level()` at construction — and those hooks must
+resolve in spawned subprocesses too. With `LOCALAI_FAULT` /
+`LOCALAI_TRANSFER_GUARD` unset every hook is a dict/env lookup returning
+None-or-empty.
 """
-from localai_tpu.testing import faults  # noqa: F401
+from localai_tpu.testing import faults, tripwires  # noqa: F401

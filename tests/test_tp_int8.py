@@ -436,6 +436,8 @@ def test_allgather_detector_not_vacuous(mesh4):
     """The HLO parser DOES see a full-weight all-gather when one exists
     (sharded weight forced back to replicated) — the assertion above has
     teeth."""
+    # lint: allow(sharding-spec-source) — detector-teeth test: a hand-built
+    # sharded weight is forced replicated to PROVE the all-gather shows up
     w = jax.device_put(jnp.zeros((64, 256), jnp.float32),
                        NamedSharding(mesh4, P(None, "model")))
     txt = jax.jit(lambda a: a * 2.0,

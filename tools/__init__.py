@@ -1,0 +1,3 @@
+# makes `python -m tools.lint` / `python -m tools.regen_pb2` resolvable from
+# the repo root; the profiling scripts in this directory stay runnable as
+# plain `python tools/<script>.py` files

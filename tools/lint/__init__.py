@@ -1,0 +1,20 @@
+"""localai-lint: project-native static analysis for trace hazards, host
+syncs, lock discipline, and contract drift.
+
+Run over the tree:   python -m tools.lint localai_tpu tools tests
+List the rules:      python -m tools.lint --list-rules
+Suppress one site:   # lint: allow(rule-name) — reason
+
+Rule families (see README "Static analysis" for the catalog):
+  trace        host syncs + recompile hazards on the serving hot paths
+  concurrency  locks across blocking calls; acquire/release try/finally
+  contract     sharding-spec provenance, pb2 import discipline, pytest
+               marker registration
+
+The runtime complements (what AST analysis can't see) live in
+localai_tpu/testing/tripwires.py: a jax.transfer_guard around the fused
+decode dispatch and a compile-count guard for decode_step.
+"""
+from tools.lint.core import (   # noqa: F401
+    Config, Violation, get_rules, run_paths, run_source,
+)

@@ -1,0 +1,51 @@
+"""CLI: `python -m tools.lint [paths...]` — emits `file:line rule message`
+per violation and exits nonzero when any survive their pragmas. This is the
+CI gate; it needs nothing beyond the stdlib."""
+from __future__ import annotations
+
+import argparse
+import sys
+
+from tools.lint.core import Config, get_rules, run_paths
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.lint",
+        description="localai-tpu project-native static analysis")
+    ap.add_argument("paths", nargs="*",
+                    default=["localai_tpu", "tools", "tests"],
+                    help="files/directories to lint (default: the tree)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    ap.add_argument("--select", default="",
+                    help="comma-separated rule names to run (default: all)")
+    ap.add_argument("--statistics", action="store_true",
+                    help="append a per-rule violation count summary")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in get_rules(Config()):
+            print(f"{rule.name:28s} [{rule.family}] {rule.description}")
+        return 0
+
+    select = tuple(s.strip() for s in args.select.split(",") if s.strip())
+    config = Config(select=select)
+    violations = run_paths(args.paths, config)
+    for v in violations:
+        print(v.render())
+    if args.statistics and violations:
+        counts: dict[str, int] = {}
+        for v in violations:
+            counts[v.rule] = counts.get(v.rule, 0) + 1
+        print("--")
+        for rule, n in sorted(counts.items(), key=lambda kv: -kv[1]):
+            print(f"{n:5d}  {rule}")
+    if violations:
+        print(f"-- {len(violations)} violation(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

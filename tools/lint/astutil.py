@@ -1,0 +1,276 @@
+"""Shared AST helpers: dotted-name resolution, a line-ordered device-value
+tracker (the light intra-function dataflow the trace-hygiene rules run on),
+and jit-wrapper discovery."""
+from __future__ import annotations
+
+import ast
+
+
+def dotted(node: ast.AST) -> str | None:
+    """`a.b.c` for a Name/Attribute chain, None for anything else."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def last_segment(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def call_name(call: ast.Call) -> str | None:
+    return dotted(call.func)
+
+
+def walk_skip_defs(node: ast.AST):
+    """ast.walk that does NOT descend into nested function/class definitions
+    (their bodies run at some other time — not under this lock / not in this
+    trace)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                          ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+# calls rooted here produce (or may produce) device arrays
+DEVICE_ROOTS = ("jnp.", "jax.")
+# ...except these: they return host values / metadata, or ARE the explicit
+# device→host spelling
+HOST_SAFE_CALLS = {
+    "jax.device_get", "jax.device_count", "jax.local_device_count",
+    "jax.devices", "jax.local_devices", "jax.process_count",
+    "jax.process_index", "jax.default_backend", "jax.tree_util.keystr",
+    "jnp.finfo", "jnp.iinfo", "jnp.dtype", "jnp.shape", "jnp.ndim",
+    "jnp.issubdtype", "jax.eval_shape",
+}
+# attribute reads on a device value that are host metadata, never a sync
+SAFE_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "itemsize",
+              "nbytes", "at", "aval", "weak_type"}
+
+
+def is_device_call(call: ast.Call, jit_names: set[str] | None = None) -> bool:
+    """Does this call plausibly return a device array? jnp./jax. calls (minus
+    the host-safe set), calls to `*_fn` attributes (the project's convention
+    for jit-wrapped callables), and calls to known jit-created names."""
+    name = call_name(call)
+    if name is None:
+        return False
+    if name in HOST_SAFE_CALLS:
+        return False
+    if any(name.startswith(r) or name == r[:-1] for r in DEVICE_ROOTS):
+        return True
+    seg = name.rsplit(".", 1)[-1]
+    if seg.endswith("_fn"):
+        return True
+    if jit_names and seg in jit_names:
+        return True
+    return False
+
+
+class DeviceTracker:
+    """Per-function, line-ordered tracking of which local names currently
+    hold device values. Assignments from device-producing calls mark the
+    targets; reassignment from host expressions clears them. Control flow is
+    approximated by source order — good enough for a linter."""
+
+    def __init__(self, func: ast.AST, jit_names: set[str] | None = None):
+        self.jit_names = jit_names or set()
+        # name -> sorted [(lineno, is_device)]
+        self.assignments: dict[str, list[tuple[int, bool]]] = {}
+        for node in walk_skip_defs(func):
+            targets: list[ast.AST] = []
+            value = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                if node.value is not None:
+                    targets, value = [node.target], node.value
+            if value is None:
+                continue
+            dev = self._expr_is_device(value)
+            for t in targets:
+                for name in self._target_names(t):
+                    self.assignments.setdefault(name, []).append(
+                        (node.lineno, dev))
+        for hist in self.assignments.values():
+            hist.sort()
+
+    @staticmethod
+    def _target_names(t: ast.AST):
+        if isinstance(t, ast.Name):
+            yield t.id
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                yield from DeviceTracker._target_names(e)
+        # attribute/subscript targets: not tracked (self._x is cross-function)
+
+    def _expr_is_device(self, expr: ast.AST) -> bool:
+        if isinstance(expr, ast.Call):
+            return is_device_call(expr, self.jit_names)
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            return any(self._expr_is_device(e) for e in expr.elts)
+        if isinstance(expr, (ast.BinOp,)):
+            return (self._expr_is_device(expr.left)
+                    or self._expr_is_device(expr.right))
+        return False
+
+    def is_device_at(self, name: str, lineno: int) -> bool:
+        # strictly-earlier assignments only: in `x = np.asarray(x)` the use
+        # on the right-hand side reads the PREVIOUS binding
+        hist = self.assignments.get(name)
+        if not hist:
+            return False
+        dev = False
+        for ln, d in hist:
+            if ln >= lineno:
+                break
+            dev = d
+        return dev
+
+
+def expr_mentions_device(expr: ast.AST, tracker: DeviceTracker,
+                         parents: dict[ast.AST, ast.AST],
+                         lineno: int) -> bool:
+    """Does `expr` read a device value in a way that forces a host sync?
+    Metadata access (.shape/.dtype/...), len(), and identity tests are
+    shielded."""
+    for node in ast.walk(expr):
+        devicey = False
+        if isinstance(node, ast.Call) and is_device_call(node,
+                                                         tracker.jit_names):
+            devicey = True
+        elif isinstance(node, ast.Name) and tracker.is_device_at(node.id,
+                                                                 lineno):
+            devicey = True
+        if not devicey:
+            continue
+        if not _is_shielded(node, expr, parents):
+            return True
+    return False
+
+
+def _is_shielded(node: ast.AST, stop: ast.AST,
+                 parents: dict[ast.AST, ast.AST]) -> bool:
+    """Walk node→stop; a .shape/.dtype/... attribute read, a len()/
+    isinstance()/getattr() call, or an `is`/`in` comparison anywhere on the
+    path means the device value itself never crosses to the host."""
+    cur = node
+    while cur is not stop and cur is not None:
+        parent = parents.get(cur)
+        if isinstance(parent, ast.Attribute) and parent.attr in SAFE_ATTRS:
+            return True
+        if isinstance(parent, ast.Call):
+            fname = dotted(parent.func)
+            if cur is not parent.func and (
+                    fname in ("len", "isinstance", "getattr", "hasattr",
+                              "type", "id", "repr")
+                    or fname in HOST_SAFE_CALLS):
+                # jax.device_get IS the sanctioned explicit sync — a device
+                # value inside it has already crossed the boundary on purpose
+                return True
+        if isinstance(parent, ast.Compare):
+            ok = all(isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+                     for op in parent.ops)
+            if ok:
+                return True
+        cur = parent
+    return False
+
+
+def collect_jit_info(tree: ast.AST):
+    """Scan a module for jit wrappings.
+
+    Returns (jitted_funcs, jit_callables):
+      jitted_funcs: {local function name: set of static/bound param names
+                     (or positional indices as ints)} for functions defined
+                     AND jit-wrapped in this module — the traced-branch rule
+                     inspects their bodies.
+      jit_callables: {assigned name (attr or local): static argnames} for
+                     names bound to jax.jit(...) results — the jit-arg rule
+                     checks calls to these.
+    """
+    jitted_funcs: dict[str, set] = {}
+    jit_callables: dict[str, set[str]] = {}
+
+    def static_names(call: ast.Call) -> set:
+        out: set = set()
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                for n in ast.walk(kw.value):
+                    if isinstance(n, ast.Constant) and isinstance(n.value,
+                                                                  str):
+                        out.add(n.value)
+            elif kw.arg == "static_argnums":
+                for n in ast.walk(kw.value):
+                    if isinstance(n, ast.Constant) and isinstance(n.value,
+                                                                  int):
+                        out.add(n.value)
+        return out
+
+    def unwrap_target(fn_arg: ast.AST) -> tuple[str | None, set]:
+        """(function name, extra static params) for jax.jit's first arg —
+        follows partial(f, bound...) one level (bound args are fixed at
+        wrap time → static)."""
+        if isinstance(fn_arg, ast.Name):
+            return fn_arg.id, set()
+        if isinstance(fn_arg, ast.Call):
+            fname = dotted(fn_arg.func)
+            if fname in ("partial", "functools.partial") and fn_arg.args:
+                inner = fn_arg.args[0]
+                if isinstance(inner, ast.Name):
+                    extra: set = set(range(1, len(fn_arg.args)))  # positions
+                    extra.update(kw.arg for kw in fn_arg.keywords if kw.arg)
+                    return inner.id, extra
+        return None, set()
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and dotted(node.func) in ("jax.jit",
+                                                                "jit"):
+            statics = static_names(node)
+            if node.args:
+                fn_name, extra = unwrap_target(node.args[0])
+                if fn_name:
+                    jitted_funcs.setdefault(fn_name, set()).update(
+                        statics | extra)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if dotted(dec) in ("jax.jit", "jit"):
+                    jitted_funcs.setdefault(node.name, set())
+                elif isinstance(dec, ast.Call) and dotted(dec.func) in (
+                        "jax.jit", "jit", "partial", "functools.partial"):
+                    inner = dec.args[0] if (dotted(dec.func) in
+                                            ("partial", "functools.partial")
+                                            and dec.args) else None
+                    if dotted(dec.func) in ("jax.jit", "jit"):
+                        jitted_funcs.setdefault(node.name, set()).update(
+                            static_names(dec))
+                    elif inner is not None and dotted(inner) in ("jax.jit",
+                                                                 "jit"):
+                        jitted_funcs.setdefault(node.name, set()).update(
+                            static_names(dec))
+    # second pass: names bound to jax.jit(...) results
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        v = node.value
+        if not (isinstance(v, ast.Call) and dotted(v.func) in ("jax.jit",
+                                                               "jit")):
+            continue
+        statics = static_names(v)
+        for t in node.targets:
+            seg = last_segment(t)
+            if seg:
+                jit_callables[seg] = statics
+    return jitted_funcs, jit_callables

@@ -1,0 +1,294 @@
+"""localai-lint core: file walking, pragma handling, rule dispatch.
+
+Stdlib-only by design (ast + tokenize + tomllib) — the CI lint job must run
+before any dependency install, and the analyzer itself can never be the
+reason a JAX upgrade breaks the tree.
+
+Suppression pragma (same line, or alone on the line directly above):
+
+    x = tok.item()   # lint: allow(host-sync-item) — admission is once/request
+
+Unknown rule names inside a pragma are themselves a violation (`bad-pragma`)
+so a typo can't silently disable a check forever.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import re
+import tokenize
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    path: str          # repo-relative, posix separators
+    line: int
+    rule: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line} {self.rule} {self.message}"
+
+
+# default hot-path scope for the JAX trace/sync family: the serving engine,
+# the kernels, and the model forward passes — a host sync there stalls the
+# decode pipeline for every tenant. tools/, telemetry/ and tests are host
+# code where a sync is the point.
+HOT_DIRS = (
+    "localai_tpu/engine/",
+    "localai_tpu/ops/",
+    "localai_tpu/models/",
+)
+
+# files the walker never lints
+EXCLUDED_FILES = {
+    "localai_tpu/backend/backend_pb2.py",   # generated (tools/regen_pb2.py)
+}
+
+# pytest markers that ship with pytest / plugins we use — never need
+# registration in pyproject.toml
+BUILTIN_MARKERS = {
+    "parametrize", "skip", "skipif", "xfail", "usefixtures",
+    "filterwarnings", "tryfirst", "trylast", "timeout", "asyncio", "anyio",
+}
+
+
+@dataclasses.dataclass
+class Config:
+    hot_dirs: tuple[str, ...] = HOT_DIRS
+    # files allowed to touch backend_pb2 directly: the shim that puts it on
+    # sys.path, and the generator that writes it
+    pb2_allowed: tuple[str, ...] = ("localai_tpu/backend/pb.py",
+                                    "tools/regen_pb2.py")
+    # call names whose result is an approved sharding-spec source
+    spec_sources: tuple[str, ...] = (
+        "param_specs", "replicated_specs", "kv_cache_spec",
+        "paged_pool_spec", "safe_sharding", "shard_params",
+    )
+    # the one module allowed to build NamedSharding from raw specs (it
+    # IMPLEMENTS safe_sharding/shard_params/constrain)
+    spec_helper_files: tuple[str, ...] = ("localai_tpu/parallel/mesh.py",)
+    registered_markers: frozenset[str] = frozenset()
+    select: tuple[str, ...] = ()     # empty = all rules
+
+    def in_hot_path(self, path: str) -> bool:
+        return any(path.startswith(d) for d in self.hot_dirs)
+
+
+def load_registered_markers(root: str) -> frozenset[str]:
+    """Marker names registered in <root>/pyproject.toml (empty set if the
+    file or table is missing). Uses tomllib when available (3.11+) and falls
+    back to extracting the quoted strings of the `markers = [...]` array —
+    the lint must run on the stock CI interpreter with zero deps."""
+    pp = os.path.join(root, "pyproject.toml")
+    try:
+        with open(pp, "rb") as f:
+            blob = f.read()
+    except OSError:
+        return frozenset()
+    markers: list[str] = []
+    try:
+        import tomllib
+
+        data = tomllib.loads(blob.decode("utf-8"))
+        markers = (data.get("tool", {}).get("pytest", {})
+                   .get("ini_options", {}).get("markers", []))
+    except ImportError:
+        m = re.search(r"^markers\s*=\s*\[(.*?)\]", blob.decode("utf-8"),
+                      re.S | re.M)
+        if m:
+            markers = re.findall(r"\"([^\"]*)\"|'([^']*)'", m.group(1))
+            markers = [a or b for a, b in markers]
+    except Exception:
+        return frozenset()
+    names = set()
+    for mk in markers:
+        name = str(mk).split(":", 1)[0].strip()
+        # strip a call-form registration like "timeout(seconds)"
+        names.add(name.split("(", 1)[0].strip())
+    return frozenset(names)
+
+
+class FileContext:
+    """Everything a rule needs about one parsed file."""
+
+    def __init__(self, path: str, source: str, tree: ast.AST, config: Config):
+        self.path = path
+        self.source = source
+        self.tree = tree
+        self.config = config
+        self.parents: dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+
+    def parent(self, node: ast.AST) -> ast.AST | None:
+        return self.parents.get(node)
+
+    def ancestors(self, node: ast.AST):
+        cur = self.parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self.parents.get(cur)
+
+
+_PRAGMA = re.compile(r"#\s*lint:\s*allow\(([^)]*)\)")
+
+
+def collect_pragmas(source: str) -> tuple[dict[int, set[str]], list[tuple[int, str]]]:
+    """Map line → rule names allowed there. A pragma comment applies to its
+    own line; when the comment stands alone on a line it also covers the next
+    line (for statements too long to carry a trailing comment).
+
+    Returns (allowed-by-line, [(line, raw-names)] for pragma validation)."""
+    allowed: dict[int, set[str]] = {}
+    raw: list[tuple[int, str]] = []
+    try:
+        toks = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return allowed, raw
+    lines = source.splitlines()
+    for tok in toks:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _PRAGMA.search(tok.string)
+        if not m:
+            continue
+        names = {n.strip() for n in m.group(1).split(",") if n.strip()}
+        line = tok.start[0]
+        raw.append((line, m.group(1)))
+        allowed.setdefault(line, set()).update(names)
+        # standalone comment → suppress the next CODE line (the pragma's
+        # reason may continue over following comment lines)
+        logical = tok.line[: tok.start[1]].strip()
+        if not logical:
+            nxt = line  # 0-based index of the line after the pragma
+            while nxt < len(lines):
+                stripped = lines[nxt].strip()
+                if stripped and not stripped.startswith("#"):
+                    allowed.setdefault(nxt + 1, set()).update(names)
+                    break
+                nxt += 1
+    return allowed, raw
+
+
+def get_rules(config: Config):
+    from tools.lint import rules_concurrency, rules_contract, rules_trace
+
+    rules = (rules_trace.RULES + rules_concurrency.RULES
+             + rules_contract.RULES)
+    if config.select:
+        rules = [r for r in rules if r.name in config.select]
+    return rules
+
+
+def run_source(source: str, path: str, config: Config | None = None):
+    """Lint one in-memory source blob as if it lived at `path` (repo-relative
+    posix). This is the API tests/test_lint.py drives with snippets."""
+    config = config or Config()
+    path = path.replace(os.sep, "/")
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Violation(path, e.lineno or 1, "syntax-error", str(e.msg))]
+    ctx = FileContext(path, source, tree, config)
+    rule_names = {r.name for r in get_rules(Config())}  # all known, unselected
+    allowed, raw_pragmas = collect_pragmas(source)
+
+    out: list[Violation] = []
+    for line, names_raw in raw_pragmas:
+        for name in (n.strip() for n in names_raw.split(",")):
+            if name and name not in rule_names:
+                out.append(Violation(
+                    path, line, "bad-pragma",
+                    f"pragma allows unknown rule {name!r} — a typo here "
+                    f"would silently disable nothing; known rules: "
+                    f"run with --list-rules"))
+    # a violation anywhere in a multi-line statement is covered by a pragma
+    # on any of the statement's lines (or the code line right below a
+    # standalone pragma, which collect_pragmas resolved to the first one)
+    spans: list[tuple[int, int]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.stmt) and getattr(node, "end_lineno", None):
+            spans.append((node.lineno, node.end_lineno))
+
+    def suppressed(rule_name: str, line: int) -> bool:
+        if rule_name in allowed.get(line, ()):
+            return True
+        best = None
+        for s, e in spans:
+            if s <= line <= e and (best is None
+                                   or (e - s) < (best[1] - best[0])):
+                best = (s, e)
+        if best is None:
+            return False
+        return any(rule_name in allowed.get(ln, ())
+                   for ln in range(best[0], best[1] + 1))
+
+    seen: set[tuple] = set()
+    for rule in get_rules(config):
+        for v in rule.check(ctx):
+            if suppressed(rule.name, v.line):
+                continue
+            key = (v.path, v.line, v.rule, v.message)
+            if key in seen:
+                continue   # nested defs are walked from both scopes
+            seen.add(key)
+            out.append(v)
+    out.sort(key=lambda v: (v.path, v.line, v.rule))
+    return out
+
+
+def find_root(start: str) -> str:
+    """Nearest ancestor of `start` containing pyproject.toml (else `start`)."""
+    cur = os.path.abspath(start)
+    if os.path.isfile(cur):
+        cur = os.path.dirname(cur)
+    while True:
+        if os.path.exists(os.path.join(cur, "pyproject.toml")):
+            return cur
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            return os.path.abspath(start)
+        cur = parent
+
+
+def iter_py_files(target: str):
+    if os.path.isfile(target):
+        yield target
+        return
+    for dirpath, dirnames, filenames in os.walk(target):
+        dirnames[:] = [d for d in dirnames
+                       if d != "__pycache__" and not d.startswith(".")]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def run_paths(targets: list[str], config: Config | None = None,
+              root: str | None = None):
+    """Lint every .py file under `targets`. Paths in violations are relative
+    to `root` (auto-detected via pyproject.toml when not given)."""
+    root = os.path.abspath(root or find_root(targets[0] if targets else "."))
+    config = config or Config()
+    if not config.registered_markers:
+        config = dataclasses.replace(
+            config, registered_markers=load_registered_markers(root))
+    out: list[Violation] = []
+    for target in targets:
+        for fp in iter_py_files(target):
+            rel = os.path.relpath(os.path.abspath(fp), root).replace(
+                os.sep, "/")
+            if rel in EXCLUDED_FILES:
+                continue
+            try:
+                with open(fp, encoding="utf-8") as f:
+                    src = f.read()
+            except (OSError, UnicodeDecodeError) as e:
+                out.append(Violation(rel, 1, "unreadable", str(e)))
+                continue
+            out.extend(run_source(src, rel, config))
+    out.sort(key=lambda v: (v.path, v.line, v.rule))
+    return out

@@ -1,0 +1,193 @@
+"""Family (b): concurrency discipline.
+
+`lock-across-blocking` is the PR 4 watchdog bug class: the seed's watchdog
+held the model-map lock across Popen.wait(timeout=10), freezing every
+load()/get() for the duration of a reap. `acquire-release-finally` is the
+mark_busy audit from the same PR turned permanent: an acquire whose release
+isn't exception-protected leaks the resource on the first RpcError."""
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.lint.astutil import call_name, dotted, last_segment, walk_skip_defs
+from tools.lint.core import Violation
+
+_LOCKLIKE = re.compile(r"lock|mutex|sem(aphore)?$|^cond(ition)?$", re.I)
+
+# method names that block the calling thread
+_BLOCKING_ATTRS = {
+    "wait", "join", "communicate", "accept", "connect", "recv", "recv_into",
+    "sendall", "result", "acquire",
+}
+# fully-dotted blocking calls
+_BLOCKING_CALLS = {
+    "time.sleep", "sleep", "subprocess.run", "subprocess.call",
+    "subprocess.check_call", "subprocess.check_output",
+    "socket.create_connection", "urlopen", "urllib.request.urlopen",
+}
+_BLOCKING_PREFIXES = ("requests.",)
+# receiver segments that mark an RPC client object (BackendClient, gRPC
+# stubs/channels) — any method call on them goes over the wire
+_RPC_SEGMENTS = {"client", "stub", "channel"}
+_RPC_EXEMPT_METHODS = {"close", "cancel", "done", "add_done_callback"}
+
+
+def _is_string_join(recv: ast.AST) -> bool:
+    """`", ".join(...)` / `os.path.join(...)` / `os.sep.join(...)` are string
+    and path joins, not thread joins."""
+    if isinstance(recv, (ast.Constant, ast.JoinedStr)):
+        return True
+    chain = dotted(recv)
+    if chain and any(seg in ("path", "sep", "pathsep", "linesep")
+                     for seg in chain.lower().split(".")):
+        return True
+    return False
+
+
+def _is_lock_expr(expr: ast.AST) -> bool:
+    """`with self._lock:` / `with lock:` / `with self._model_lock(name):`"""
+    if isinstance(expr, ast.Call):
+        expr = expr.func
+    seg = last_segment(expr)
+    return bool(seg and _LOCKLIKE.search(seg))
+
+
+def _blocking_reason(node: ast.Call) -> str | None:
+    name = call_name(node)
+    if name in _BLOCKING_CALLS:
+        return name
+    if name and any(name.startswith(p) for p in _BLOCKING_PREFIXES):
+        return name
+    if isinstance(node.func, ast.Attribute):
+        attr = node.func.attr
+        if attr in _BLOCKING_ATTRS:
+            if attr == "join" and _is_string_join(node.func.value):
+                return None
+            return f".{attr}()"
+        if attr == "get":
+            recv = last_segment(node.func.value)
+            if recv and "queue" in recv.lower():
+                return f"{recv}.get()"
+        # RPC client call: any segment of the receiver chain names a
+        # client/stub/channel
+        if attr not in _RPC_EXEMPT_METHODS:
+            chain = dotted(node.func)
+            if chain:
+                segments = chain.lower().split(".")[:-1]
+                if any(s in _RPC_SEGMENTS or s.endswith("client")
+                       or s.endswith("stub") for s in segments):
+                    return f"RPC {chain}()"
+    return None
+
+
+class LockAcrossBlocking:
+    name = "lock-across-blocking"
+    family = "concurrency"
+    description = ("lock held across a blocking call (process wait, sleep, "
+                   "RPC, socket) — the PR 4 watchdog bug class")
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            locks = [item.context_expr for item in node.items
+                     if _is_lock_expr(item.context_expr)]
+            if not locks:
+                continue
+            lock_desc = dotted(locks[0]) or (
+                dotted(locks[0].func) if isinstance(locks[0], ast.Call)
+                else "lock")
+            for stmt in node.body:
+                for sub in self._walk_body(stmt):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    reason = _blocking_reason(sub)
+                    if reason:
+                        yield Violation(
+                            ctx.path, sub.lineno, self.name,
+                            f"{reason} while holding {lock_desc!r} — "
+                            f"snapshot state under the lock, do the "
+                            f"blocking work outside it (seed watchdog held "
+                            f"the model-map lock across Popen.wait)")
+
+    @staticmethod
+    def _walk_body(stmt):
+        yield stmt
+        yield from walk_skip_defs(stmt)
+
+
+# acquire method → (release method, release must exist in same function)
+_PAIRS = {
+    "mark_busy": ("mark_idle", True),
+    "acquire": ("release", False),   # bare-acquire lock usage; with-stmt
+                                     # preferred, release may live elsewhere
+    "begin": ("finish", False),      # telemetry spans: a span finished in
+                                     # the same function must do so in a
+                                     # finally (engine spans legitimately
+                                     # finish in _release_slot)
+}
+
+
+class AcquireReleaseFinally:
+    name = "acquire-release-finally"
+    family = "concurrency"
+    description = ("resource acquire (mark_busy, span begin, lock.acquire) "
+                   "whose release is not protected by try/finally")
+
+    def check(self, ctx):
+        for fn in (n for n in ast.walk(ctx.tree)
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))):
+            if fn.name in _PAIRS or fn.name in {r for r, _ in
+                                                _PAIRS.values()}:
+                continue   # the definitions themselves
+            for acq_name, (rel_name, must_exist) in _PAIRS.items():
+                acquires = self._calls(fn, acq_name)
+                if not acquires:
+                    continue
+                releases = self._calls(fn, rel_name)
+                protected = [r for r in releases
+                             if self._in_finally(r, fn, ctx)]
+                if releases and not protected:
+                    for a in acquires:
+                        yield Violation(
+                            ctx.path, a.lineno, self.name,
+                            f"{acq_name}() paired with {rel_name}() outside "
+                            f"any finally — an exception between them leaks "
+                            f"the resource; use "
+                            f"{acq_name}(); try: ... finally: {rel_name}()")
+                elif not releases and must_exist:
+                    for a in acquires:
+                        yield Violation(
+                            ctx.path, a.lineno, self.name,
+                            f"{acq_name}() with no {rel_name}() in the same "
+                            f"function — busy accounting must be released "
+                            f"in a finally at the call site")
+
+    @staticmethod
+    def _calls(fn, method: str):
+        out = []
+        for node in walk_skip_defs(fn):
+            if isinstance(node, ast.Call):
+                seg = (node.func.attr if isinstance(node.func, ast.Attribute)
+                       else (node.func.id if isinstance(node.func, ast.Name)
+                             else None))
+                if seg == method:
+                    out.append(node)
+        return out
+
+    @staticmethod
+    def _in_finally(node, fn, ctx) -> bool:
+        cur = node
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, ast.Try) and any(
+                    cur is s or any(cur is d for d in ast.walk(s))
+                    for s in anc.finalbody):
+                return True
+            if anc is fn:
+                return False
+        return False
+
+
+RULES = [LockAcrossBlocking(), AcquireReleaseFinally()]

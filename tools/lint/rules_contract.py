@@ -1,0 +1,165 @@
+"""Family (c): contract drift.
+
+`sharding-spec-source`: PR 3 added validate_specs because a hand-written
+PartitionSpec that misses an axis silently replicates a TP'd weight on every
+chip. The durable fix is provenance: sharding call sites must take their
+specs from the audited catalog (models/llama.param_specs and friends) or
+through safe_sharding — not from an inline P('model', ...) literal.
+
+`pb2-direct-import`: backend_pb2.py is generated (tools/regen_pb2.py); code
+importing it directly bypasses the sys.path shim in backend/pb.py and, worse,
+normalizes hand-editing the generated file.
+
+`pytest-marker-registered`: an unregistered marker makes `-m slow`-style
+selection silently select nothing — tier-1/slow/resilience lane splitting
+depends on markers meaning what pyproject.toml says they mean."""
+from __future__ import annotations
+
+import ast
+
+from tools.lint.astutil import call_name, dotted, last_segment
+from tools.lint.core import BUILTIN_MARKERS, Violation
+
+
+def _spec_has_axis_names(expr: ast.AST) -> bool:
+    """True when `expr` is an inline P(...)/PartitionSpec(...) literal with at
+    least one string axis name. P()/P(None, ...) is explicit replication —
+    harmless, allowed anywhere."""
+    if not isinstance(expr, ast.Call):
+        return False
+    if call_name(expr) not in ("P", "PartitionSpec",
+                               "jax.sharding.PartitionSpec"):
+        return False
+    for a in expr.args:
+        for n in ast.walk(a):
+            if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                return True
+    return False
+
+
+class ShardingSpecSource:
+    name = "sharding-spec-source"
+    family = "contract"
+    description = ("sharding spec at a NamedSharding/with_sharding_constraint/"
+                   "shard_map site is an inline P(...) literal, not sourced "
+                   "from param_specs/safe_sharding")
+
+    def check(self, ctx):
+        cfg = ctx.config
+        if ctx.path in cfg.spec_helper_files:
+            return
+        approved = set(cfg.spec_sources)
+        # names assigned from approved source calls are fine to pass around
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            seg = last_segment(node.func)
+            spec_args: list[ast.AST] = []
+            site = None
+            if seg == "NamedSharding" and len(node.args) >= 2:
+                spec_args, site = [node.args[1]], "NamedSharding"
+            elif seg == "with_sharding_constraint" and len(node.args) >= 2:
+                spec_args, site = [node.args[1]], name or seg
+            elif seg in ("shard_map", "_shard_map"):
+                spec_args = [kw.value for kw in node.keywords
+                             if kw.arg in ("in_specs", "out_specs")]
+                site = "shard_map"
+            if not spec_args:
+                continue
+            for arg in spec_args:
+                for sub in ast.walk(arg):
+                    if not _spec_has_axis_names(sub):
+                        continue
+                    # inline literal with real axis names: only allowed when
+                    # it is itself wrapped by an approved source call
+                    # (e.g. safe_sharding(mesh, P(...), shape))
+                    if self._under_approved_call(sub, arg, ctx, approved):
+                        continue
+                    yield Violation(
+                        ctx.path, sub.lineno, self.name,
+                        f"inline PartitionSpec with axis names at a {site} "
+                        f"site — source specs from "
+                        f"param_specs/kv_cache_spec/paged_pool_spec or wrap "
+                        f"in safe_sharding so non-dividing axes degrade "
+                        f"instead of silently replicating")
+                    break
+
+    @staticmethod
+    def _under_approved_call(sub, stop, ctx, approved) -> bool:
+        cur = sub
+        while cur is not None and cur is not stop:
+            parent = ctx.parent(cur)
+            if isinstance(parent, ast.Call):
+                seg = last_segment(parent.func)
+                if seg in approved:
+                    return True
+            cur = parent
+        return False
+
+
+class Pb2DirectImport:
+    name = "pb2-direct-import"
+    family = "contract"
+    description = ("direct *_pb2 import outside backend/pb.py and "
+                   "tools/regen_pb2.py — bypasses the generated-file "
+                   "contract")
+
+    def check(self, ctx):
+        if ctx.path in ctx.config.pb2_allowed:
+            return
+        for node in ast.walk(ctx.tree):
+            mods: list[str] = []
+            if isinstance(node, ast.Import):
+                mods = [a.name for a in node.names]
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                mods = [node.module]
+                mods += [f"{node.module}.{a.name}" for a in node.names]
+            for mod in mods:
+                leaf = mod.rsplit(".", 1)[-1]
+                if not leaf.endswith("_pb2") and not leaf.endswith(
+                        "_pb2_grpc"):
+                    continue
+                if mod.startswith("google."):
+                    continue   # upstream protobuf runtime modules
+                yield Violation(
+                    ctx.path, node.lineno, self.name,
+                    f"import of {mod!r} bypasses localai_tpu.backend.pb — "
+                    f"message classes come from `from localai_tpu.backend "
+                    f"import pb`; regen via tools/regen_pb2.py, never "
+                    f"hand-edit backend_pb2.py")
+                break
+
+
+class PytestMarkerRegistered:
+    name = "pytest-marker-registered"
+    family = "contract"
+    description = ("pytest marker used under tests/ but not registered in "
+                   "pyproject.toml — `-m` selection on it silently matches "
+                   "nothing")
+
+    def check(self, ctx):
+        if not ctx.path.startswith("tests/"):
+            return
+        known = BUILTIN_MARKERS | set(ctx.config.registered_markers)
+        seen: set[tuple[str, int]] = set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Attribute):
+                continue
+            chain = dotted(node)
+            if not chain or not chain.startswith("pytest.mark."):
+                continue
+            marker = chain.split(".")[2]
+            key = (marker, node.lineno)
+            if marker in known or key in seen:
+                continue
+            seen.add(key)
+            yield Violation(
+                ctx.path, node.lineno, self.name,
+                f"marker {marker!r} is not registered in "
+                f"[tool.pytest.ini_options].markers — register it (with a "
+                f"lane note) or the tier-1/slow/tp/resilience splits can't "
+                f"see it")
+
+
+RULES = [ShardingSpecSource(), Pb2DirectImport(), PytestMarkerRegistered()]
