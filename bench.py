@@ -58,6 +58,24 @@ def note(msg):
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
 
+def dispatch_stats(metrics: dict) -> dict:
+    """Dispatch-fusing scoreboard fields from an engine metrics map (local
+    dict or the GetMetrics RPC payload): decode dispatch count, fused
+    steps/dispatch, and host-sync wait per generated token. These are the
+    numbers the single-dispatch decode loop moves — promoted into the bench
+    JSON line so the scoreboard can gate on them."""
+    d = int(metrics.get("decode_dispatches", 0))
+    s = int(metrics.get("decode_steps_dispatched", 0))
+    toks = int(metrics.get("tokens_generated", 0))
+    wait = float(metrics.get("host_sync_wait_ms", 0.0))
+    return {
+        "decode_dispatches": d,
+        "decode_steps_dispatched": s,
+        "steps_per_dispatch": round(s / max(d, 1), 2),
+        "host_sync_wait_ms_per_token": round(wait / max(toks, 1), 4),
+    }
+
+
 # ---------------------------------------------------------- run artifacts
 # The scoreboard contract (ROADMAP open item #1 / VERDICT round-5 ask #1):
 # BENCH_rN.json must never print `device: cpu` while a real on-chip artifact
@@ -367,13 +385,17 @@ def bench_serve(args, size: str, on_cpu: bool):
                 tput.append(len(all_arr) / wall)
             note(f"window {w}: {tput[-1]:.1f} tok/s "
                  f"({len(all_arr)} tokens, wall {wall:.1f}s)")
+        stats = {}
         try:
             m = handle.client.metrics()
+            stats = dispatch_stats(m)
             d, s = m.get("decode_dispatches", 0), m.get(
                 "decode_steps_dispatched", 0)
             note(f"engine: {d:.0f} decode dispatches, {s:.0f} steps "
                  f"({s / max(d, 1):.1f} steps/dispatch), "
-                 f"{m.get('admit_dispatches', 0):.0f} admit dispatches")
+                 f"{m.get('admit_dispatches', 0):.0f} admit dispatches, "
+                 f"host-sync wait "
+                 f"{stats['host_sync_wait_ms_per_token']:.3f} ms/token")
         except Exception:
             pass
         if getattr(args, "trace", False):
@@ -381,7 +403,7 @@ def bench_serve(args, size: str, on_cpu: bool):
                 args.trace_payload = handle.client.trace()
             except Exception as e:
                 note(f"trace fetch failed: {e}")
-        return statistics.median(tput), ttft_ms, context, dtype
+        return statistics.median(tput), ttft_ms, context, dtype, stats
     finally:
         manager.stop_all()
         import shutil
@@ -427,6 +449,10 @@ def bench_engine(args, size: str, on_cpu: bool, kv_pages: int | None = None,
         # 32-slot engine-mode runs OOM'd at admit compile)
         cache_type="int8" if dtype in ("int8", "int4") else "",
         kv_pages=args.kv_pages if kv_pages is None else kv_pages,
+        # A/B the single-dispatch decode loop (None = engine default 64;
+        # 0 regresses to the scan-block ladder for comparison runs)
+        **({} if args.decode_loop is None
+           else {"decode_loop": args.decode_loop}),
     ))
     rng = np.random.default_rng(0)
 
@@ -436,6 +462,13 @@ def bench_engine(args, size: str, on_cpu: bool, kv_pages: int | None = None,
             params=SamplingParams(temperature=0.8, top_k=40,
                                   seed=int(rng.integers(1 << 30))),
             max_tokens=n_tokens, ignore_eos=True)
+
+    # pre-compile the decode-loop variants + remaining ladder widths NOW so
+    # window 0 measures steady-state, not mid-stream XLA compiles (the old
+    # warmup compiled only the shapes its own short requests happened to hit)
+    t0 = time.perf_counter()
+    eng.warmup()
+    note(f"decode programs pre-compiled in {time.perf_counter() - t0:.1f}s")
 
     t0 = time.perf_counter()
     for _ in range(args.slots):
@@ -478,10 +511,13 @@ def bench_engine(args, size: str, on_cpu: bool, kv_pages: int | None = None,
             pass
     m = eng.metrics
     d = max(m["decode_dispatches"], 1)
+    stats = dispatch_stats(m)
     note(f"engine: {m['decode_dispatches']} decode dispatches, "
          f"{m['decode_steps_dispatched']} steps "
          f"({m['decode_steps_dispatched'] / d:.1f} steps/dispatch), "
-         f"{m['admit_dispatches']} admit dispatches")
+         f"{m['admit_dispatches']} admit dispatches, "
+         f"host-sync wait {stats['host_sync_wait_ms_per_token']:.3f} "
+         f"ms/token")
     if getattr(args, "trace", False):
         from localai_tpu import telemetry
 
@@ -493,7 +529,7 @@ def bench_engine(args, size: str, on_cpu: bool, kv_pages: int | None = None,
     import shutil
 
     shutil.rmtree(tmp, ignore_errors=True)
-    return statistics.median(tput), ttft_ms, context, dtype
+    return statistics.median(tput), ttft_ms, context, dtype, stats
 
 
 def bench_paged(args, size: str, on_cpu: bool):
@@ -504,7 +540,7 @@ def bench_paged(args, size: str, on_cpu: bool):
     pattern and must never ship silently again."""
     from localai_tpu.ops.paged import BLOCK
 
-    dense_tps, dense_ttft, context, dtype = bench_engine(
+    dense_tps, dense_ttft, context, dtype, _ = bench_engine(
         args, size, on_cpu, kv_pages=0)
     note(f"dense: {dense_tps:.1f} tok/s")
     pages = args.kv_pages
@@ -515,11 +551,12 @@ def bench_paged(args, size: str, on_cpu: bool):
         tokens = min(args.prompt_len + args.decode_steps + 33, context)
         pages = args.slots * (-(-tokens // BLOCK)) + 1
     note(f"paged pool: {pages} blocks")
-    paged_tps, paged_ttft, _, _ = bench_engine(
+    paged_tps, paged_ttft, _, _, stats = bench_engine(
         args, size, on_cpu, kv_pages=pages)
     note(f"paged: {paged_tps:.1f} tok/s "
          f"({paged_tps / max(dense_tps, 1e-9):.2f}x dense)")
-    return dense_tps, dense_ttft, paged_tps, paged_ttft, pages, context, dtype
+    return (dense_tps, dense_ttft, paged_tps, paged_ttft, pages, context,
+            dtype, stats)
 
 
 def bench_embed(args, size: str, on_cpu: bool):
@@ -676,6 +713,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--decode-steps", type=int, default=128)
     p.add_argument("--windows", type=int, default=5)
     p.add_argument("--context", type=int, default=1024)
+    p.add_argument("--decode-loop", type=int, default=None,
+                   help="max steps per single-dispatch while-loop decode "
+                        "block (engine mode; default: engine's 64; 0 "
+                        "disables the loop — scan-ladder comparison runs)")
     p.add_argument("--kv-pages", type=int, default=0,
                    help="paged KV pool size in 128-token blocks "
                         "(0 = dense per-slot cache); lets slot count "
@@ -818,10 +859,10 @@ def main(argv=None):
             note(f"geometry shards over no more than {tp} device(s) — "
                  "tp_over_single would be vacuous")
             return 2
-        single_tps, single_ttft, context, dtype = bench_engine(
+        single_tps, single_ttft, context, dtype, _ = bench_engine(
             args, size, on_cpu, tp=0)
         note(f"single device: {single_tps:.1f} tok/s")
-        tp_tps, tp_ttft, _, _ = bench_engine(args, size, on_cpu, tp=tp)
+        tp_tps, tp_ttft, _, _, stats = bench_engine(args, size, on_cpu, tp=tp)
         note(f"tp 1x{tp}: {tp_tps:.1f} tok/s global "
              f"({tp_tps / max(single_tps, 1e-9):.2f}x single)")
         n_params = param_count(size)
@@ -845,6 +886,7 @@ def main(argv=None):
             "mfu": None if on_cpu else round(mfu, 4),
             "device": device_kind,
             "params": n_params,
+            **stats,
         }
         if on_cpu and not args.cpu:
             result["probe_error"] = probe_error[:500]
@@ -858,7 +900,7 @@ def main(argv=None):
         dev = jax.devices()[0]
         device_kind = getattr(dev, "device_kind", dev.platform)
         (dense_tps, dense_ttft, toks_per_s, ttft_ms, pages, context,
-         dtype) = bench_paged(args, size, on_cpu)
+         dtype, stats) = bench_paged(args, size, on_cpu)
         n_params = param_count(size)
         mfu = (toks_per_s * 2 * n_params) / peak_flops_per_chip(device_kind)
         result = {
@@ -879,6 +921,7 @@ def main(argv=None):
             "mfu": None if on_cpu else round(mfu, 4),
             "device": device_kind,
             "params": n_params,
+            **stats,
         }
         if on_cpu and not args.cpu:
             result["probe_error"] = probe_error[:500]
@@ -886,7 +929,8 @@ def main(argv=None):
     if args.mode == "serve":
         # the parent process stays JAX-free: the backend subprocess owns the
         # accelerator, exactly like production serving
-        toks_per_s, ttft_ms, context, dtype = bench_serve(args, size, on_cpu)
+        toks_per_s, ttft_ms, context, dtype, stats = bench_serve(
+            args, size, on_cpu)
     else:
         if on_cpu and args.tensor_parallel > 1:
             ensure_virtual_devices(args.tensor_parallel)
@@ -897,7 +941,8 @@ def main(argv=None):
         note("initializing device client...")
         dev = jax.devices()[0]
         device_kind = getattr(dev, "device_kind", dev.platform)
-        toks_per_s, ttft_ms, context, dtype = bench_engine(args, size, on_cpu)
+        toks_per_s, ttft_ms, context, dtype, stats = bench_engine(
+            args, size, on_cpu)
 
     n_params = param_count(size)
     # a TP run measures GLOBAL tok/s over `chips` devices: the scoreboard
@@ -926,6 +971,7 @@ def main(argv=None):
         "mfu": None if on_cpu else round(mfu, 4),
         "device": device_kind,
         "params": n_params,
+        **stats,
     }
     if on_cpu and not args.cpu:
         result["probe_error"] = probe_error[:500]

@@ -69,6 +69,16 @@ class EngineConfig:
                                   # (amortizes host↔device latency; falls back
                                   # to single steps around grammar masks,
                                   # pending admissions, and context limits)
+    decode_loop: int = 64         # single-dispatch decode loop: up to this
+                                  # many sample→decode steps fused into ONE
+                                  # on-device lax.while_loop with per-slot
+                                  # stop conditions (EOS set, max_tokens
+                                  # budget, context margin) evaluated on
+                                  # device and early exit when every live
+                                  # slot finished. 0/1 disables — the engine
+                                  # then serves on the decode_block scan
+                                  # ladder. Grammar and stop-string slots
+                                  # always keep the host-verified block path.
     dtype: str | None = None      # default: model dtype
     cache_type: str = ""          # ""|bf16 dense; int8|q8_0 quantized KV
                                   # (reference CacheTypeKey/Value,
@@ -174,6 +184,38 @@ class _Slot:
                                      # needs the full-sort path)
     span: Any = None                 # open telemetry span for this request
                                      # (None when tracing is disabled)
+    inflight: int = 0                # tokens reserved by in-flight (not yet
+                                     # consumed) decode dispatches — the
+                                     # pipelined loop path budgets the NEXT
+                                     # dispatch's per-slot `remaining` net of
+                                     # this, so a slot can never overshoot
+                                     # max_tokens however dispatches overlap
+
+
+class _AsyncFetch:
+    """Async, double-buffered device→host result streaming (PRESERVE-style
+    overlap): the D2H copy of a dispatch's small outputs (tokens, logprobs,
+    per-slot counters) STARTS the moment the dispatch is enqueued —
+    `copy_to_host_async` — so block N's tokens land in host memory while
+    block N+1 computes. `wait()` then completes through `jax.device_get`
+    (the sanctioned explicit transfer); on the pipelined hot path the data
+    has already arrived and the call returns without a device stall."""
+
+    __slots__ = ("_arrays",)
+
+    def __init__(self, arrays):
+        self._arrays = tuple(arrays)
+        for a in self._arrays:
+            try:
+                a.copy_to_host_async()
+            except Exception:
+                # layouts without an async path (some sharded/committed
+                # arrays): wait() still fetches correctly, just later
+                pass
+
+    def wait(self):
+        """Finish the copies; returns host numpy arrays in input order."""
+        return tuple(np.asarray(jax.device_get(a)) for a in self._arrays)
 
 
 class Engine:
@@ -297,6 +339,11 @@ class Engine:
             "decode_dispatches": 0,
             "decode_steps_dispatched": 0,
             "admit_dispatches": 0,
+            # cumulative ms the engine thread spent BLOCKED waiting for a
+            # dispatch's results to land on the host (the async-fetch wait,
+            # not the detok/stream fan-out) — per token this is the number
+            # the decode-loop + copy_to_host_async work is driving to zero
+            "host_sync_wait_ms": 0.0,
         }
         if self._draft is not None:
             self.metrics["draft_proposed"] = 0
@@ -384,6 +431,15 @@ class Engine:
             self._sampler = SamplerState.init(B, V)
             self._last_logits = jnp.zeros((B, V), jnp.float32)
             self._lengths = jnp.zeros((B,), jnp.int32)
+            # device-resident EOS id set for the fused decode loop's on-device
+            # stop condition (padded with -1 when the model has no tokenizer —
+            # no sampled token matches, the budget/margin conditions still
+            # bound the loop). Uploaded once, never per dispatch.
+            eos = sorted(self.tok.eos_ids) if (
+                self.tok is not None and getattr(self.tok, "eos_ids", None)
+            ) else []
+            self._eos_dev = jnp.asarray(
+                np.asarray(eos or [-1], np.int32))
             if self._draft is not None:
                 dcfg = self._draft[0]
                 self._cos_d, self._sin_d = rope_table(dcfg.rope, T)
@@ -643,6 +699,34 @@ class Engine:
             _decode_block, donate_argnums=(3, 4, 5, 6, 7),
             static_argnames=("steps", "fast_width"))
 
+        # single-dispatch decode loop (Kernel Looping): the while-loop
+        # variant of the scan block, with stop conditions ON DEVICE and
+        # early exit — one dispatch per decode_loop-token block instead of
+        # the scan ladder's 4-8 (models/llama.build_decode_loop). The raw
+        # (un-constrained) _decode is the body so the per-step RNG/count
+        # semantics are bit-identical to the other paths; the tiny outputs
+        # are replicated for the rank-0 host read like _decode's.
+        self._decode_loop_fn = None
+        if self.ec.decode_loop > 1:
+            from localai_tpu.models.llama import build_decode_loop
+
+            _loop_raw = build_decode_loop(
+                _decode_raw,
+                max_steps=self.ec.decode_loop,
+                limit=self.ec.max_context - 2 - self._ctx_reserve)
+
+            def _loop(*a, **kw):
+                (toks, lps, n_out, steps, kc, vc, sampler, last_logits,
+                 lengths) = _loop_raw(*a, **kw)
+                return (constrain(toks, P(None, None)),
+                        constrain(lps, P(None, None)),
+                        constrain(n_out, P(None)), steps,
+                        kc, vc, sampler, last_logits, lengths)
+
+            self._decode_loop_fn = jax.jit(
+                _loop, donate_argnums=(3, 4, 5, 6, 7),
+                static_argnames=("fast_width",))
+
     # ------------------------------------------------------ device dispatch
     # Every device call goes through one of these. On a multi-host mesh the
     # rank-0 engine broadcasts (op, args) over the Replicator side channel
@@ -802,7 +886,7 @@ class Engine:
         self._obs("decode", t0, tokens=int(np.sum(active)), fence=tokens,
                   fast_width=fast_width or 0,
                   grammar=mask_host is not None)
-        return tokens, logprobs
+        return _AsyncFetch((tokens, logprobs))
 
     def _dev_decode_block(self, active, steps: int, fast_width=None,
                           mask_host=None):
@@ -829,7 +913,35 @@ class Engine:
         self._obs("decode_block", t0, tokens=steps * int(np.sum(active)),
                   fence=tokens, steps=steps, fast_width=fast_width or 0,
                   grammar=mask_host is not None)
-        return tokens, logprobs
+        return _AsyncFetch((tokens, logprobs))
+
+    def _dev_decode_loop(self, active, remaining, check_eos, fast_width=None):
+        """ONE while-loop dispatch covering up to ec.decode_loop decode steps
+        with per-slot stop conditions on device (models/llama.py
+        build_decode_loop). `remaining` [B] i32 is each slot's token budget
+        for THIS dispatch (max_tokens net of in-flight reservations);
+        `check_eos` [B] bool gates the EOS-set stop. Steps actually run come
+        back with the async fetch — the dispatch-step metric is credited at
+        consume time, when the early-exit count is known."""
+        self.metrics["decode_dispatches"] += 1
+        t0 = time.perf_counter()
+        self._bcast("decode_loop", active=active, remaining=remaining,
+                    check_eos=check_eos, fast_width=fast_width)
+        with activate_mesh(self.mesh), self._decode_guard():
+            (toks, lps, n_out, steps, self._kc, self._vc, self._sampler,
+             self._last_logits, self._lengths) = self._decode_loop_fn(
+                self.params, self._cos, self._sin, self._kc, self._vc,
+                self._sampler, self._last_logits, self._lengths,
+                jnp.asarray(active), jnp.asarray(remaining),
+                jnp.asarray(check_eos), self._eos_dev, self._tab(),
+                fast_width=fast_width)
+        # tokens here is the RESERVED upper bound (actual count rides the
+        # fetch); the consume-side "sample" stage records the exact number
+        self._obs("decode_loop", t0,
+                  tokens=int(np.minimum(np.maximum(remaining, 0),
+                                        self.ec.decode_loop).sum()),
+                  fence=toks, fast_width=fast_width or 0)
+        return _AsyncFetch((toks, lps, n_out, steps))
 
     def _dev_shift(self, idx):
         t0 = time.perf_counter()
@@ -890,7 +1002,7 @@ class Engine:
         self._obs("spec_decode", t0,
                   tokens=(self.ec.gamma + 1) * int(np.sum(active)),
                   fence=tokens_out)
-        return tokens_out, n_out, logprobs_out, n_extra
+        return _AsyncFetch((tokens_out, n_out, logprobs_out, n_extra))
 
     def follow(self, channel) -> None:
         """Follower-rank loop (multi-host, process_index > 0): replay the
@@ -936,6 +1048,9 @@ class Engine:
         elif op == "decode_block":
             self._dev_decode_block(kw["active"], int(kw["steps"]),
                                    kw.get("fast_width"), kw.get("mask"))
+        elif op == "decode_loop":
+            self._dev_decode_loop(kw["active"], kw["remaining"],
+                                  kw["check_eos"], kw.get("fast_width"))
         elif op == "shift":
             self._dev_shift(kw["idx"])
         elif op == "draft_ingest":
@@ -1385,11 +1500,56 @@ class Engine:
                 return 1
         return steps
 
+    def _loop_eligible(self, entries) -> bool:
+        """Whether this dispatch can go loop-native (ONE while_loop dispatch,
+        stop conditions on device). Host-verified decisions keep the
+        block/ladder path: grammar masks and stop strings need per-token
+        host checks, speculative decoding has its own fused program, and
+        pending admissions/chunked prefills must not wait out a whole loop
+        (the device cannot see the host queue mid-dispatch)."""
+        if self._decode_loop_fn is None or self._draft is not None:
+            return False
+        if self._grammar_slots > 0 or self._prefillq:
+            return False
+        if self._free and not self._queue.empty():
+            return False
+        return all(not self._slots[i].req.stop for i, _ in entries)
+
+    def _dispatch_loop(self, active, entries, fast):
+        """Dispatch the fused while-loop block. Per-slot `remaining` budgets
+        are max_tokens net of the PENDING dispatch's reservation, so two
+        loop blocks can pipeline without ever overshooting a budget; a slot
+        whose whole budget is already in flight sits this dispatch out (the
+        device would run it zero steps anyway)."""
+        G = self.ec.decode_loop
+        B = self.ec.max_slots
+        remaining = np.zeros((B,), np.int32)
+        check_eos = np.zeros((B,), bool)
+        live = []
+        for i, rid in entries:
+            s = self._slots[i]
+            rem = s.req.max_tokens - s.generated - s.inflight
+            if rem <= 0:
+                active[i] = False
+                continue
+            remaining[i] = rem
+            check_eos[i] = self.tok is not None and not s.req.ignore_eos
+            live.append((i, rid))
+        if not live:
+            return None
+        res = {}
+        for i, _ in live:
+            res[i] = int(min(G, remaining[i]))
+            self._slots[i].inflight += res[i]
+        self._inflight_steps = G
+        fetch = self._dev_decode_loop(active, remaining, check_eos, fast)
+        return ("loop", fetch, live, res)
+
     def _dispatch(self):
-        """Dispatch one decode step — or a fused block of them — for the
-        currently-active slots; returns (tokens_dev, logprobs_dev,
-        [(slot_idx, request_id)]) without waiting for the device — or None if
-        nothing is active. Block results have a leading steps axis."""
+        """Dispatch one decode step, a fused scan block, or a single-dispatch
+        while loop for the currently-active slots; returns a tagged pend
+        ("loop"|"block", async fetch, [(slot_idx, request_id)], ...) without
+        waiting for the device — or None if nothing can run."""
         active = self._active_mask()
         if not active.any():
             return None
@@ -1405,18 +1565,75 @@ class Engine:
                   else None for i, _ in entries]
             if all(w is not None for w in ws):
                 fast = max(ws)
+        if self._loop_eligible(entries):
+            return self._dispatch_loop(active, entries, fast)
         steps = self._block_steps()
         # snapshot the dispatch-time masks: _consume compares each slot's
         # refreshed mask against what the device sampled under, to catch the
         # allowed-set GROWING mid-block (see _consume)
         gmask = self._mask_host.copy() if self._grammar_slots > 0 else None
         self._inflight_steps = steps
+        res = {}
+        for i, _ in entries:
+            res[i] = steps
+            self._slots[i].inflight += steps
         if steps > 1:
-            tokens, logprobs = self._dev_decode_block(active, steps, fast,
-                                                      gmask)
+            fetch = self._dev_decode_block(active, steps, fast, gmask)
         else:
-            tokens, logprobs = self._dev_decode(active, gmask, fast)
-        return tokens, logprobs, entries, gmask
+            fetch = self._dev_decode(active, gmask, fast)
+        return ("block", fetch, entries, gmask, res)
+
+    def _release_reservations(self, entries, res):
+        """Return a consumed dispatch's per-slot token reservations (see
+        _Slot.inflight) before emitting — emission moves the budget from
+        `inflight` into `generated`."""
+        for i, rid in entries:
+            s = self._slots[i]
+            if s is not None and s.request_id == rid:
+                s.inflight = max(0, s.inflight - res.get(i, 0))
+
+    def _dispatch_gauges(self):
+        """Refresh the profiler's dispatch-fusing gauges (prof_* GetMetrics
+        keys → scoreboard/Prometheus). Profiling-mode only — the disabled
+        hot path stays a None-check."""
+        if self._prof is None:
+            return
+        m = self.metrics
+        d = max(m["decode_dispatches"], 1)
+        self._prof.set_gauges(
+            decode_dispatches_count=m["decode_dispatches"],
+            steps_per_dispatch=m["decode_steps_dispatched"] / d,
+            host_sync_wait_ms_per_token=(
+                m["host_sync_wait_ms"] / max(m["tokens_generated"], 1)))
+
+    def _consume_loop(self, pend):
+        """Consume a fused while-loop dispatch: finish the async token fetch,
+        credit the ACTUAL step count (early exit makes it <= decode_loop),
+        and commit slot b's n_out[b] tokens in device order. The host still
+        re-derives every finish decision in _emit — cancel/deadline can
+        terminate a slot mid-buffer, and the rest of its tokens are dropped
+        by the request-id check exactly as on the block path."""
+        _, fetch, entries, res = pend
+        t0 = time.perf_counter()
+        tokens, logprobs, n_out, steps = fetch.wait()
+        self.metrics["host_sync_wait_ms"] += (time.perf_counter() - t0) * 1e3
+        steps = int(steps)
+        self.metrics["decode_steps_dispatched"] += steps
+        self._release_reservations(entries, res)
+        now = time.monotonic()
+        emitted = 0
+        for g in range(steps):
+            for i, rid in entries:
+                if g >= int(n_out[i]):
+                    continue
+                slot = self._slots[i]
+                if slot is None or slot.request_id != rid:
+                    continue  # finished earlier (cancel/deadline/shift race)
+                self._emit(i, slot, int(tokens[g, i]),
+                           float(logprobs[g, i]), now)
+                emitted += 1
+        self._obs("sample", t0, tokens=emitted, steps=steps, rollbacks=0)
+        self._dispatch_gauges()
 
     def _consume(self, pend):
         """Block on a dispatched step's results and run the host-side token
@@ -1425,10 +1642,14 @@ class Engine:
         their block-START mask: the first token a slot's (live) PDA rejects
         marks that slot for rollback — its accepted prefix stands, the rest of
         its block is discarded, and _repair restores the device state."""
-        tokens, logprobs, entries, gmask = pend
+        if pend[0] == "loop":
+            self._consume_loop(pend)
+            return
+        _, fetch, entries, gmask, res = pend
         t0 = time.perf_counter()
-        tokens = np.asarray(jax.device_get(tokens))
-        logprobs = np.asarray(jax.device_get(logprobs))
+        tokens, logprobs = fetch.wait()
+        self.metrics["host_sync_wait_ms"] += (time.perf_counter() - t0) * 1e3
+        self._release_reservations(entries, res)
         now = time.monotonic()
         if tokens.ndim == 1:
             tokens, logprobs = tokens[None], logprobs[None]
@@ -1459,11 +1680,13 @@ class Engine:
             slot = self._slots[i]
             if slot is not None:
                 self._repair(i, slot)
-        # "sample" = the host side of sampling: result sync (device_get of
-        # the sampled tokens — the per-step host↔device boundary) plus token
-        # commit (grammar advance, detok, stop scan, stream fan-out)
+        # "sample" = the host side of sampling: async-fetch completion (the
+        # copy started at dispatch — on the pipelined path it has usually
+        # already landed) plus token commit (grammar advance, detok, stop
+        # scan, stream fan-out)
         self._obs("sample", t0, tokens=steps * len(entries),
                   steps=steps, rollbacks=len(rolled))
+        self._dispatch_gauges()
 
     def _repair(self, idx: int, slot: _Slot):
         """Roll a grammar slot back to its last PDA-accepted token after a
@@ -1502,8 +1725,10 @@ class Engine:
                        for i in np.where(active)[0]]
             pend = self._dev_spec_decode(active)
             self._prefill_tick()   # admission overlaps the device step
-            tokens_out, n_out, logprobs_out, n_extra = (
-                np.asarray(jax.device_get(x)) for x in pend)
+            t0 = time.perf_counter()
+            tokens_out, n_out, logprobs_out, n_extra = pend.wait()
+            self.metrics["host_sync_wait_ms"] += (
+                time.perf_counter() - t0) * 1e3
             now = time.monotonic()
             G = self.ec.gamma
             for i, rid in entries:
@@ -2036,6 +2261,46 @@ class Engine:
         self._free.append(idx)
 
     # ------------------------------------------------------------ run modes
+
+    def warmup(self):
+        """Pre-compile the decode hot-path programs — the while-loop decode
+        variants (every sort-free sampling tier) plus the remaining scan
+        ladder widths the grammar/stop-string fallback still rides — so the
+        first requests (and bench window 0) never pay an XLA compile
+        mid-stream. Dispatches run with an all-inactive slot mask: every
+        cache write redirects to the trash row/block and no slot state is
+        consumed, but it MUST run before any request is admitted. Dispatch
+        metrics are snapshotted so warmup doesn't pollute the fusing
+        telemetry."""
+        if any(s is not None for s in self._slots):
+            raise RuntimeError("warmup() requires an idle engine")
+        B, V = self.ec.max_slots, self.cfg.vocab_size
+        snap = {k: self.metrics[k] for k in (
+            "decode_dispatches", "decode_steps_dispatched",
+            "host_sync_wait_ms")}
+        idle = np.zeros((B,), bool)
+        try:
+            if self._draft is not None:
+                self._dev_spec_decode(idle).wait()
+                return
+            widths = [None]
+            W = self.ec.sampling_topk_width
+            if W:
+                widths.append(min(W, V))
+                if min(8 * W, V) != min(W, V):
+                    widths.append(min(8 * W, V))   # the escalation tier
+            for w in widths:
+                if self._decode_loop_fn is not None:
+                    self._dev_decode_loop(
+                        idle, np.zeros((B,), np.int32),
+                        np.zeros((B,), bool), w).wait()
+                self._dev_decode(idle, None, w).wait()
+            steps = self.ec.decode_block
+            while steps > 1:
+                self._dev_decode_block(idle, steps, None, None).wait()
+                steps //= 2
+        finally:
+            self.metrics.update(snap)
 
     def start(self):
         """Run the engine loop in a background thread (serving mode)."""

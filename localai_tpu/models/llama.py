@@ -664,6 +664,93 @@ def decode_step(params, cfg: LlamaConfig, tokens, lengths, cos, sin,
     return logits, k_cache, v_cache
 
 
+def build_decode_loop(step_fn, *, max_steps: int, limit: int):
+    """While-loop variant of the fused decode block (Kernel Looping,
+    arXiv:2410.23668): up to `max_steps` sample→decode iterations run as ONE
+    on-device `lax.while_loop` dispatch, with per-slot stop conditions
+    evaluated from device-resident state — no host round trip per block, no
+    host-side power-of-two step ladder.
+
+    `step_fn` is the engine's fused sample→decode body
+    (params, cos, sin, kc, vc, sampler, last_logits, lengths, active,
+    mask_bits, fast_width, table) → (tokens, logprobs, kc, vc, sampler,
+    logits, lengths) — the SAME body the scan block and the single-step
+    dispatch run, so per-slot RNG streams are identical across paths.
+
+    Per-iteration stop conditions (computed on device, per slot):
+    - EOS-set membership: sampled token ∈ `eos_ids` for slots with
+      `check_eos` (host clears it for ignore_eos requests);
+    - token budget: the slot produced `remaining` tokens this dispatch
+      (max_tokens net of in-flight reservations, shipped per dispatch);
+    - context margin: the slot's cache length reached `limit` (static,
+      max_context minus the decode margin) — the host then finishes the
+      request or context-shifts it and the loop resumes next dispatch.
+
+    A finished slot is frozen: its sampler key and last_logits stop
+    advancing (so a context-shifted slot resumes the exact RNG stream the
+    single-step path would have used), its length stops, and its cache
+    writes redirect to the trash row/block via `step_fn`'s active mask.
+    The loop EARLY-EXITS once every live slot froze — a dispatch costs only
+    the steps it actually ran (`steps_run` proves it).
+
+    Tokens land in an on-device ring buffer [max_steps, B]; the engine
+    streams them out via async device→host copies (engine._AsyncFetch).
+    Returns (tokens [max_steps, B], logprobs [max_steps, B], n_out [B],
+    steps_run, kc, vc, sampler, last_logits, lengths) — slot b's valid
+    tokens are rows 0..n_out[b)-1.
+    """
+
+    def decode_loop(params, cos, sin, kc, vc, sampler, last_logits, lengths,
+                    active, remaining, check_eos, eos_ids, table=None,
+                    fast_width=None):
+        B = lengths.shape[0]
+        init = (
+            jnp.int32(0),                            # steps run
+            ~active,                                 # done (per slot)
+            jnp.zeros((B,), jnp.int32),              # n_out
+            jnp.zeros((max_steps, B), jnp.int32),    # token ring buffer
+            jnp.zeros((max_steps, B), jnp.float32),  # logprob ring buffer
+            kc, vc, sampler, last_logits, lengths,
+        )
+
+        def cond(carry):
+            i, done = carry[0], carry[1]
+            return (i < max_steps) & jnp.any(~done)
+
+        def body(carry):
+            (i, done, n_out, toks, lps, kc, vc, sampler, last_logits,
+             lengths) = carry
+            live = ~done
+            prev_key = sampler.key
+            tokens, lp, kc, vc, sampler, logits, lengths = step_fn(
+                params, cos, sin, kc, vc, sampler, last_logits, lengths,
+                live, None, fast_width, table)
+            # freeze finished slots: their key stream and last_logits hold
+            # at the finishing token (step_fn already gates lengths and
+            # token_counts on the active mask)
+            sampler = dataclasses.replace(
+                sampler,
+                key=jnp.where(live[:, None], sampler.key, prev_key))
+            last_logits = jnp.where(live[:, None], logits, last_logits)
+            toks = toks.at[i].set(tokens)
+            lps = lps.at[i].set(lp)
+            n_out = n_out + live.astype(jnp.int32)
+            is_eos = check_eos & jnp.any(
+                tokens[:, None] == eos_ids[None, :], axis=1)
+            done = done | (live & (is_eos
+                                   | (n_out >= remaining)
+                                   | (lengths >= limit)))
+            return (i + 1, done, n_out, toks, lps, kc, vc, sampler,
+                    last_logits, lengths)
+
+        (steps, _, n_out, toks, lps, kc, vc, sampler, last_logits,
+         lengths) = jax.lax.while_loop(cond, body, init)
+        return (toks, lps, n_out, steps, kc, vc, sampler, last_logits,
+                lengths)
+
+    return decode_loop
+
+
 def hidden_states(params, cfg: LlamaConfig, tokens, lengths=None):
     """Full-sequence causal forward → final-norm hidden states [B, S, H].
     `lengths` masks padded positions out of attention (defaults to full)."""

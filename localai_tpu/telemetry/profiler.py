@@ -115,9 +115,19 @@ class StepProfiler:
         for size in (mesh or {}).values():
             self.chips *= max(int(size), 1)
         self._stages: dict[str, _Stage] = {}
+        self._gauges: dict[str, float] = {}
         self._lock = threading.Lock()
         self._first_t: float | None = None
         self._last_t: float = 0.0
+
+    def set_gauges(self, **gauges: float) -> None:
+        """Scalar engine-level gauges (dispatch-fusing telemetry: decode
+        dispatch count, steps/dispatch, host-sync wait per token). Surfaced
+        through report()["gauges"] and as bare prof_<name> GetMetrics keys
+        so the bench scoreboard and Prometheus layer can gate on them."""
+        with self._lock:
+            for k, v in gauges.items():
+                self._gauges[k] = float(v)
 
     def record(self, stage: str, t0: float, tokens: int = 0,
                fence=None) -> float:
@@ -178,6 +188,7 @@ class StepProfiler:
             s["share"] = s["total_ms"] / (total * 1e3) if total else 0.0
         return {
             "stages": stages,
+            "gauges": dict(self._gauges),
             "wall_ms": wall * 1e3,
             "busy_ms": total * 1e3,
             "coverage": (total / wall) if wall > 0 else 0.0,
@@ -199,6 +210,8 @@ class StepProfiler:
                 out[f"{prefix}{name}_p50_ms"] = st.p50_s() * 1e3
                 if st.tokens and st.total_s > 0:
                     out[f"{prefix}{name}_tok_s"] = st.tokens / st.total_s
+            for name, v in self._gauges.items():
+                out[f"{prefix}{name}"] = v
         return out
 
 

@@ -16,11 +16,19 @@ see; these two guards catch what it can't:
   compilations via jax.log_compiles. A perf PR that makes `decode_step`
   retrace per request (tracer branch, data-dependent shape, unhashed jit
   arg) fails the guard long before anyone reads a profile.
+
+- dispatch-count guard: `dispatch_budget(engine, ...)` asserts the enclosed
+  stream keeps the decode-dispatch count within a budget per 128 generated
+  tokens. The single-dispatch while-loop makes a 128-token single-slot
+  stream ~2 dispatches; a regression back to the scan ladder (8-16) or to
+  per-step dispatches (128) trips the guard in a tier-1 test instead of a
+  chip profile.
 """
 from __future__ import annotations
 
 import contextlib
 import logging
+import math
 import os
 
 
@@ -50,7 +58,8 @@ def transfer_guard(level: str = "disallow"):
 # by design and are not covered by the exactly-once contract)
 DECODE_FN_ATTRS = (
     "_decode_fn", "_decode_nomask_fn", "_decode_fast_fn",
-    "_decode_block_fn", "_decode_block_mask_fn", "_spec_fn",
+    "_decode_block_fn", "_decode_block_mask_fn", "_decode_loop_fn",
+    "_spec_fn",
 )
 
 
@@ -81,6 +90,27 @@ def decode_compile_count(engine) -> int:
     sizes = decode_cache_sizes(engine)
     known = [v for v in sizes.values() if v >= 0]
     return sum(known)
+
+
+@contextlib.contextmanager
+def dispatch_budget(engine, max_per_128_tokens: float = 3.0):
+    """Decode-dispatch counter guard: assert the enclosed stream spends no
+    more than `max_per_128_tokens` decode dispatches per 128 generated
+    tokens (pro-rated, floor 1). Reads the engine's own decode_dispatches /
+    tokens_generated counters, so it works across loop, block, and spec
+    paths without instrumentation."""
+    m = engine.metrics
+    d0, t0 = m["decode_dispatches"], m["tokens_generated"]
+    yield
+    dispatches = m["decode_dispatches"] - d0
+    tokens = m["tokens_generated"] - t0
+    allowed = max(1, math.ceil(tokens / 128.0 * max_per_128_tokens))
+    if dispatches > allowed:
+        raise AssertionError(
+            f"decode dispatch budget exceeded: {dispatches} dispatches for "
+            f"{tokens} generated tokens (allowed {allowed} at "
+            f"{max_per_128_tokens}/128-token) — the fused decode loop is "
+            f"not engaging or has regressed to the ladder")
 
 
 class CompileCounter:

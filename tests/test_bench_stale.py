@@ -84,7 +84,7 @@ def test_main_cpu_fallback_flag_still_runs_cpu(tmp_path, capsys, monkeypatch):
                         lambda args: (True, "no tpu", "cpu"))
     monkeypatch.setattr(bench, "bench_serve",
                         lambda args, size, on_cpu: (123.0, 5.0, 1024,
-                                                    "float32"))
+                                                    "float32", {}))
     rc = bench.main(["--runs-dir", d, "--allow-cpu-fallback"])
     assert rc == 0
     result = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
@@ -100,7 +100,7 @@ def test_explicit_cpu_run_skips_stale_path(tmp_path, capsys, monkeypatch):
     _write(d, "chip.json", {"device": "TPU v5e", "value": 726.7})
     monkeypatch.setattr(bench, "bench_serve",
                         lambda args, size, on_cpu: (50.0, 9.0, 1024,
-                                                    "float32"))
+                                                    "float32", {}))
     rc = bench.main(["--cpu", "--runs-dir", d])
     assert rc == 0
     result = json.loads(capsys.readouterr().out.strip().splitlines()[-1])

@@ -1,0 +1,248 @@
+"""Single-dispatch on-device decode loop (ISSUE 6).
+
+The fused `lax.while_loop` decode path (models/llama.build_decode_loop,
+engine _dispatch_loop/_consume_loop) must be observationally identical to
+the per-step reference path — same fused sample→decode body, same per-slot
+RNG streams — while collapsing a 64–128-token block into ONE dispatch:
+
+- fused-while vs per-step parity across mixed streams, including slots
+  hitting EOS at different steps mid-block, in f32 and int8-W, dense and
+  paged, single device and a 4-device virtual TP mesh;
+- device-side early exit: when every slot finishes at step k of an N-step
+  loop, the device step counter proves only k steps ran;
+- async double-buffered token streaming: tokens arrive in order, and a
+  mid-stream cancel still yields a terminal event.
+"""
+import time
+
+import numpy as np
+import pytest
+import jax
+
+from localai_tpu.engine.engine import Engine, EngineConfig, GenRequest
+from localai_tpu.models.llama import LlamaConfig, init_params
+from localai_tpu.ops.quant import quantize_params
+from localai_tpu.ops.sampling import SamplingParams
+from localai_tpu.parallel.mesh import MeshConfig, build_mesh
+
+# head/kv/ffn/vocab dims all divide 4 so the same geometry runs the
+# 4-device TP mesh leg
+TINY = dict(vocab_size=96, hidden_size=32, intermediate_size=64,
+            num_layers=2, num_heads=4, num_kv_heads=4, head_dim=16,
+            max_position=512, dtype="float32")
+
+CFG = LlamaConfig(**TINY)
+
+
+class FakeTok:
+    """The minimal tokenizer surface the engine's decode path touches."""
+
+    def __init__(self, eos=()):
+        self.eos_ids = set(eos)
+
+    def stream_decoder(self):
+        class _D:
+            def push(self, t):
+                return f"<{t}>"
+
+            def flush(self):
+                return ""
+
+        return _D()
+
+
+@pytest.fixture(scope="module")
+def f32_params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def int8_params(f32_params):
+    return quantize_params(f32_params)
+
+
+def _reqs(n=3, max_tokens=20):
+    """Mixed prompts/knobs: greedy, seeded top-k, seeded top-p."""
+    protos = [
+        ([1, 2, 3, 4, 5], SamplingParams(temperature=0.0)),
+        (list(range(4, 17)), SamplingParams(temperature=0.9, top_k=20,
+                                            seed=7)),
+        (list(range(9, 14)), SamplingParams(temperature=0.7, top_p=0.9,
+                                            seed=3)),
+    ]
+    return [GenRequest(prompt_ids=list(p), params=sp, max_tokens=max_tokens,
+                       ignore_eos=True)
+            for p, sp in protos[:n]]
+
+
+def _run(params, reqs, *, loop, tok=None, mesh=None, kv_pages=0,
+         decode_loop=16, max_context=256):
+    eng = Engine(CFG, params, tok, EngineConfig(
+        max_slots=4, max_context=max_context, prefill_buckets=(16, 64),
+        decode_block=1 if not loop else 8,
+        decode_loop=decode_loop if loop else 0,
+        mesh=mesh, kv_pages=kv_pages, prompt_cache=False))
+    outs = [eng.submit(r) for r in reqs]
+    for _ in range(2000):
+        if not eng.step():
+            break
+    res = []
+    for rid, q in outs:
+        toks, reason = [], None
+        while not q.empty():
+            o = q.get()
+            toks.append(o.token_id)
+            if o.finished:
+                reason = o.finish_reason
+        res.append((toks, reason))
+    return res, eng
+
+
+@pytest.mark.parametrize("dtype,paged", [
+    ("f32", 0), ("f32", 24), ("int8", 0), ("int8", 24),
+], ids=["f32-dense", "f32-paged", "int8-dense", "int8-paged"])
+def test_fused_while_matches_per_step(f32_params, int8_params, dtype, paged):
+    """Parity: the while-loop path and the single-step reference emit
+    identical token streams and finish reasons for a mixed stream (the loop
+    reuses the same fused sample→decode body, so per-slot RNG streams line
+    up step for step)."""
+    params = f32_params if dtype == "f32" else int8_params
+    got, loop_eng = _run(params, _reqs(), loop=True, kv_pages=paged)
+    ref, ref_eng = _run(params, _reqs(), loop=False, kv_pages=paged)
+    assert got == ref
+    assert all(r == "length" for _, r in got)
+    # the loop path actually fused: ~2 dispatches per 20-token stream at
+    # decode_loop=16 vs ~20 for the per-step reference
+    assert loop_eng.metrics["decode_dispatches"] * 4 <= \
+        ref_eng.metrics["decode_dispatches"]
+
+
+def test_mixed_eos_mid_block_parity(f32_params):
+    """Slots hit EOS at DIFFERENT steps mid-block: the device-side EOS-set
+    stop must finish each slot at exactly the token the host path would
+    have, and the post-EOS loop iterations must not perturb any surviving
+    slot's stream."""
+    # discover each slot's unconstrained stream, then promote tokens that
+    # appear at different depths (step 3 of slot 0, step 9 of slot 1) to EOS
+    base, _ = _run(f32_params, _reqs(), loop=False)
+    eos = {base[0][0][3], base[1][0][9]}
+    tok = FakeTok(eos)
+    reqs = [GenRequest(prompt_ids=r.prompt_ids, params=r.params,
+                       max_tokens=r.max_tokens, ignore_eos=False)
+            for r in _reqs()]
+
+    def fresh():
+        return [GenRequest(prompt_ids=list(r.prompt_ids), params=r.params,
+                           max_tokens=r.max_tokens, ignore_eos=False)
+                for r in reqs]
+
+    got, _ = _run(f32_params, fresh(), loop=True, tok=tok)
+    ref, _ = _run(f32_params, fresh(), loop=False, tok=tok)
+    assert got == ref
+    reasons = [r for _, r in got]
+    assert reasons.count("eos") >= 2, reasons
+    # EOS at step 3 means 4 emitted tokens (the EOS token is emitted with
+    # finished=True, matching the host path)
+    assert len(got[0][0]) == 4
+
+
+@pytest.mark.tp
+def test_fused_while_parity_on_tp_mesh(f32_params, int8_params):
+    """Loop vs per-step parity UNDER the same 4-device TP mesh (f32 and
+    int8-W): the sharding constraints inside the loop body must reproduce
+    the scan block's numerics exactly — same mesh, same reduction order."""
+    for params in (f32_params, int8_params):
+        mesh = build_mesh(MeshConfig(data=1, model=4), jax.devices()[:4])
+        got, _ = _run(params, _reqs(n=2), loop=True, mesh=mesh)
+        mesh = build_mesh(MeshConfig(data=1, model=4), jax.devices()[:4])
+        ref, _ = _run(params, _reqs(n=2), loop=False, mesh=mesh)
+        assert got == ref
+        assert all(r == "length" for _, r in got)
+
+
+def test_early_exit_skips_dead_steps(f32_params):
+    """All slots finish at step 3 of a 64-step loop: the device's step
+    counter (credited into decode_steps_dispatched at consume) proves the
+    loop exited instead of burning the remaining 61 steps."""
+    eng = Engine(CFG, f32_params, None, EngineConfig(
+        max_slots=2, max_context=256, prefill_buckets=(16,),
+        decode_loop=64, prompt_cache=False))
+    reqs = [GenRequest(prompt_ids=[1 + i, 2, 3], max_tokens=3,
+                       params=SamplingParams(temperature=0.0),
+                       ignore_eos=True) for i in range(2)]
+    outs = [eng.submit(r) for r in reqs]
+    for _ in range(100):
+        if not eng.step():
+            break
+    for _, q in outs:
+        last = None
+        while not q.empty():
+            last = q.get()
+        assert last.finished and last.finish_reason == "length"
+    assert eng.metrics["decode_dispatches"] == 1
+    assert eng.metrics["decode_steps_dispatched"] == 3
+    assert eng.metrics["tokens_generated"] == 6
+
+
+def test_async_stream_order_and_mid_stream_cancel(f32_params):
+    """Under double-buffered async fetches tokens still stream strictly in
+    order, and cancelling mid-stream yields a terminal cancelled event while
+    a concurrent stream runs to completion."""
+    eng = Engine(CFG, f32_params, None, EngineConfig(
+        max_slots=2, max_context=256, prefill_buckets=(16,),
+        decode_loop=16, prompt_cache=False))
+    eng.start()
+    try:
+        rid, q = eng.submit(GenRequest(
+            prompt_ids=[1, 2, 3], params=SamplingParams(temperature=0.0),
+            max_tokens=200, ignore_eos=True))
+        _, q2 = eng.submit(GenRequest(
+            prompt_ids=[4, 5], params=SamplingParams(temperature=0.0),
+            max_tokens=24, ignore_eos=True))
+        seen = [q.get(timeout=30) for _ in range(5)]
+        # strictly ordered, gapless stream
+        assert [o.generated_tokens for o in seen] == [1, 2, 3, 4, 5]
+        eng.cancel(rid)
+        deadline = time.monotonic() + 30
+        last = None
+        while time.monotonic() < deadline:
+            last = q.get(timeout=30)
+            if last.finished:
+                break
+        assert last is not None and last.finished
+        assert last.finish_reason == "cancelled"
+        # cancellation latency is bounded by the loop block, not max_tokens
+        assert last.generated_tokens < 200
+        # the surviving stream is unaffected and terminates normally
+        toks = []
+        while True:
+            o = q2.get(timeout=30)
+            toks.append(o.token_id)
+            if o.finished:
+                assert o.finish_reason == "length"
+                break
+        assert len(toks) == 24
+    finally:
+        eng.stop()
+
+
+def test_loop_respects_max_tokens_exactly(f32_params):
+    """Pipelined loop dispatches must never overshoot a budget: per-slot
+    reservations make the second in-flight block skip slots whose budget is
+    fully reserved."""
+    for n in (1, 15, 16, 17, 40):
+        eng = Engine(CFG, f32_params, None, EngineConfig(
+            max_slots=1, max_context=256, prefill_buckets=(16,),
+            decode_loop=16, prompt_cache=False))
+        _, q = eng.submit(GenRequest(
+            prompt_ids=[1, 2, 3], params=SamplingParams(temperature=0.0),
+            max_tokens=n, ignore_eos=True))
+        for _ in range(500):
+            if not eng.step():
+                break
+        toks = []
+        while not q.empty():
+            o = q.get()
+            toks.append(o.token_id)
+        assert len(toks) == n, f"max_tokens={n} emitted {len(toks)}"
+        assert o.finished and o.finish_reason == "length"

@@ -476,17 +476,20 @@ def test_decode_compiles_exactly_once_across_mixed_stream(tiny_engine_parts):
     """The compile-count guard (acceptance): a mixed-length request stream
     with uniform sampling knobs compiles the decode step EXACTLY once —
     prefill buckets absorb prompt-length variance, and a second stream of
-    fresh lengths compiles NOTHING new anywhere."""
+    fresh lengths compiles NOTHING new anywhere. Extended to the while-loop
+    path: after engine warmup() the whole compiled-variant set is CLOSED —
+    a full mixed stream (loop dispatches included) adds zero programs."""
     from localai_tpu.engine.engine import Engine, EngineConfig, GenRequest
     from localai_tpu.ops.sampling import SamplingParams
     from localai_tpu.testing.tripwires import (
         CompileCounter, decode_cache_sizes, decode_compile_count,
+        jit_cache_size,
     )
 
     cfg, params = tiny_engine_parts
     eng = Engine(cfg, params, None, EngineConfig(
         max_slots=2, max_context=128, prefill_buckets=(16, 64),
-        decode_block=1, prompt_cache=False))
+        decode_block=1, decode_loop=0, prompt_cache=False))
     eng.start()
     try:
         greedy = SamplingParams(temperature=0.0)
@@ -510,6 +513,73 @@ def test_decode_compiles_exactly_once_across_mixed_stream(tiny_engine_parts):
     finally:
         eng.stop()
 
+    # ---- while-loop path: the loop program compiles once per sort-free
+    # sampling tier at warmup and NEVER again — a retracing loop body
+    # (tracer-dependent shape, unhashed arg) would grow the cache here
+    loop_eng = Engine(cfg, params, None, EngineConfig(
+        max_slots=2, max_context=128, prefill_buckets=(16, 64),
+        decode_block=4, decode_loop=32, prompt_cache=False))
+    loop_eng.warmup()
+    warm = decode_compile_count(loop_eng)
+    loop_variants = jit_cache_size(loop_eng._decode_loop_fn)
+    assert loop_variants >= 1
+    loop_eng.start()
+    try:
+        greedy = SamplingParams(temperature=0.0)
+        mixed = [GenRequest(prompt_ids=list(range(1, 1 + n)), params=greedy,
+                            max_tokens=m, ignore_eos=True)
+                 for n, m in ((5, 6), (13, 4), (40, 8), (22, 3))]
+        reasons = _drive(loop_eng, mixed)
+        assert all(r == "length" for r in reasons), reasons
+        assert decode_compile_count(loop_eng) == warm, \
+            decode_cache_sizes(loop_eng)
+        with CompileCounter() as cc:
+            more = [GenRequest(prompt_ids=list(range(2, 2 + n)),
+                               params=greedy, max_tokens=m, ignore_eos=True)
+                    for n, m in ((9, 5), (33, 4))]
+            reasons = _drive(loop_eng, more)
+        assert all(r == "length" for r in reasons), reasons
+        assert cc.total == 0, cc.counts
+        assert jit_cache_size(loop_eng._decode_loop_fn) == loop_variants, \
+            decode_cache_sizes(loop_eng)
+    finally:
+        loop_eng.stop()
+
+
+@pytest.mark.tripwire
+def test_decode_dispatch_budget_on_128_token_stream(tiny_engine_parts):
+    """The dispatch-count guard (ISSUE 6 satellite): a 128-token single-slot
+    stream rides the fused while loop in <= 3 decode dispatches (the ladder
+    took 8-16, per-step 128). dispatch_budget raises if the loop stops
+    engaging."""
+    from localai_tpu.engine.engine import Engine, EngineConfig, GenRequest
+    from localai_tpu.ops.sampling import SamplingParams
+    from localai_tpu.testing.tripwires import dispatch_budget
+
+    cfg, params = tiny_engine_parts
+    eng = Engine(cfg, params, None, EngineConfig(
+        max_slots=2, max_context=160, prefill_buckets=(16,),
+        prompt_cache=False))
+    eng.start()
+    try:
+        with dispatch_budget(eng, max_per_128_tokens=3.0):
+            reasons = _drive(eng, [GenRequest(
+                prompt_ids=[1, 2, 3, 4, 5],
+                params=SamplingParams(temperature=0.0),
+                max_tokens=128, ignore_eos=True)])
+        assert reasons == ["length"]
+        assert eng.metrics["decode_dispatches"] <= 3, eng.metrics
+        assert eng.metrics["decode_steps_dispatched"] == 128, eng.metrics
+        # and the guard itself has teeth: a budget of 0.5/128 must trip
+        with pytest.raises(AssertionError, match="dispatch budget"):
+            with dispatch_budget(eng, max_per_128_tokens=0.25):
+                _drive(eng, [GenRequest(
+                    prompt_ids=[1, 2, 3],
+                    params=SamplingParams(temperature=0.0),
+                    max_tokens=128, ignore_eos=True)])
+    finally:
+        eng.stop()
+
 
 @pytest.mark.tripwire
 def test_transfer_guard_clean_on_fused_decode(tiny_engine_parts,
@@ -523,21 +593,25 @@ def test_transfer_guard_clean_on_fused_decode(tiny_engine_parts,
     from localai_tpu.ops.sampling import SamplingParams
 
     cfg, params = tiny_engine_parts
-    eng = Engine(cfg, params, None, EngineConfig(
-        max_slots=2, max_context=128, prefill_buckets=(16, 64),
-        decode_block=4, prompt_cache=False))
-    assert eng._xfer_guard == "disallow"
-    eng.start()
-    try:
-        reqs = [GenRequest(prompt_ids=list(range(1, 1 + n)),
-                           params=SamplingParams(temperature=0.0),
-                           max_tokens=12, ignore_eos=True)
-                for n in (6, 30)]
-        reasons = _drive(eng, reqs)
-        assert all(r == "length" for r in reasons), reasons
-        assert eng.metrics["tokens_generated"] == 24
-    finally:
-        eng.stop()
+    # decode_loop=16 covers the single-dispatch while-loop path (ISSUE 6:
+    # its per-dispatch uploads — active/remaining/check_eos — must all be
+    # explicit); decode_loop=0 covers the scan-block fallback
+    for loop in (16, 0):
+        eng = Engine(cfg, params, None, EngineConfig(
+            max_slots=2, max_context=128, prefill_buckets=(16, 64),
+            decode_block=4, decode_loop=loop, prompt_cache=False))
+        assert eng._xfer_guard == "disallow"
+        eng.start()
+        try:
+            reqs = [GenRequest(prompt_ids=list(range(1, 1 + n)),
+                               params=SamplingParams(temperature=0.0),
+                               max_tokens=12, ignore_eos=True)
+                    for n in (6, 30)]
+            reasons = _drive(eng, reqs)
+            assert all(r == "length" for r in reasons), reasons
+            assert eng.metrics["tokens_generated"] == 24
+        finally:
+            eng.stop()
 
 
 @pytest.mark.tripwire

@@ -238,8 +238,11 @@ def test_prefix_cache_shares_blocks_across_slots(loaded, monkeypatch):
                                  ignore_eos=True))
     _drain(eng, q)
     # pin the slot that retains p1's pages with a LIVE request, so p2 gets
-    # the other (cold) slot: only the hash index can serve its prefix
-    _, q_live = eng.submit(GenRequest(list(p1), greedy, max_tokens=48,
+    # the other (cold) slot: only the hash index can serve its prefix.
+    # max_tokens must exceed the engine's decode_loop (64): a shorter pin
+    # finishes inside the first while-loop dispatch and frees the slot
+    # before p2 is admitted
+    _, q_live = eng.submit(GenRequest(list(p1), greedy, max_tokens=200,
                                       ignore_eos=True))
     while q_live.empty():
         eng.step()

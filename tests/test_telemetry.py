@@ -149,7 +149,8 @@ def test_engine_stage_spans_and_profile(ckpt):
         # fused dispatches, and the sample (sync+commit) stage
         assert "engine.admit" in names
         assert "engine.sample" in names
-        assert "engine.decode_block" in names or "engine.decode" in names
+        assert names & {"engine.decode_loop", "engine.decode_block",
+                        "engine.decode"}
         # one engine.request span per request, all closed, with ttft args
         reqs = [e for e in tracer.events() if e["name"] == "engine.request"]
         assert len(reqs) == 4
@@ -160,7 +161,7 @@ def test_engine_stage_spans_and_profile(ckpt):
         prof = eng._prof.report()
         assert prof["stages"]["admit"]["count"] >= 1
         decode_stages = [s for s in prof["stages"]
-                         if s in ("decode", "decode_block")]
+                         if s in ("decode", "decode_block", "decode_loop")]
         assert decode_stages
         # fenced stage totals cover most of the busy window (the >=90%
         # wall-coverage acceptance, measured on the in-process engine)
@@ -370,7 +371,8 @@ def test_debug_profile_and_prometheus_stage_series(traced_stack):
     assert prof["profiling_enabled"] is True
     stages = prof["models"]["tiny"]["stages"]
     assert "admit" in stages and "sample" in stages
-    assert any(s in stages for s in ("decode", "decode_block"))
+    assert any(s in stages for s in ("decode", "decode_block",
+                                     "decode_loop"))
     assert stages["admit"]["count"] >= 1
     assert prof["models"]["tiny"]["coverage"] > 0
 
