@@ -559,6 +559,141 @@ def bench_paged(args, size: str, on_cpu: bool):
             dtype, stats)
 
 
+# -------------------------------------------------------------- ragged mode
+
+def _ragged_leg(args, cfg, params, context, kv_pages, budget, mixed):
+    """One serving leg for --mode ragged: a `windows`-round burst workload
+    (slots requests each, decode_steps tokens each) through one engine.
+    Returns serving throughput (generated tok/s over the whole round,
+    prefill included — the number continuous batching moves), the
+    under-load TTFT distribution, and the token-budget utilization."""
+    import statistics as st
+
+    import numpy as np
+
+    from localai_tpu.engine import Engine, EngineConfig, GenRequest
+    from localai_tpu.ops.sampling import SamplingParams
+
+    eng = Engine(cfg, params, None, EngineConfig(
+        max_slots=args.slots, max_context=context,
+        prefill_buckets=(128, min(512, context)),
+        prefill_chunk=min(128, context),
+        kv_pages=kv_pages, prompt_cache=False,
+        ragged_token_budget=budget,
+        **({} if args.decode_loop is None
+           else {"decode_loop": args.decode_loop}),
+    ))
+    rng = np.random.default_rng(0)
+
+    def prompt_lens(k):
+        if mixed:
+            # 3:1 length spread averaging prompt_len — the ragged pack's
+            # whole point is that this costs nothing vs equal lengths
+            lo = max(8, args.prompt_len // 2)
+            return rng.integers(lo, args.prompt_len * 3 // 2 + 1, k).tolist()
+        return [args.prompt_len] * k
+
+    def burst(n_tokens):
+        subs = []
+        for n in prompt_lens(args.slots):
+            _, q = eng.submit(GenRequest(
+                rng.integers(1, cfg.vocab_size, n).tolist(),
+                SamplingParams(temperature=0.8, top_k=40,
+                               seed=int(rng.integers(1 << 30))),
+                max_tokens=n_tokens, ignore_eos=True))
+            subs.append((time.perf_counter(), q))
+        ttfts, n0 = [], eng.metrics["tokens_generated"]
+        t0 = time.perf_counter()
+        while True:
+            busy = eng.step()
+            now = time.perf_counter()
+            waiting = []
+            for ts, q in subs:
+                if q.empty():
+                    waiting.append((ts, q))
+                else:
+                    ttfts.append((now - ts) * 1e3)
+            subs = waiting
+            if not busy:
+                break
+        dt = time.perf_counter() - t0
+        return (eng.metrics["tokens_generated"] - n0) / dt, ttfts
+
+    t0 = time.perf_counter()
+    eng.warmup()
+    burst(4)   # admission/prefill program compiles
+    note(f"  programs compiled in {time.perf_counter() - t0:.1f}s")
+    tput, ttfts = [], []
+    for _ in range(args.windows):
+        tps, tt = burst(args.decode_steps)
+        tput.append(tps)
+        ttfts.extend(tt)
+    m = dict(eng.metrics)
+    rows = getattr(eng, "_ragged_rows", 0)
+    util = (m.get("ragged_tokens_packed", 0)
+            / max(m.get("ragged_dispatches", 0) * rows, 1))
+    ttfts.sort()
+    return {
+        "tok_s": st.median(tput),
+        "ttft_p50_ms": ttfts[len(ttfts) // 2],
+        "ttft_p95_ms": ttfts[min(len(ttfts) - 1, int(len(ttfts) * 0.95))],
+        "budget_utilization": round(util, 4),
+        "metrics": m,
+    }
+
+
+def bench_ragged(args, size: str, on_cpu: bool):
+    """Ragged continuous batching A/B (one process, same token work):
+
+      dense mixed  : mixed-length stream, ragged off (bucketed prefill +
+                     separate decode dispatches) — the ragged_over_dense
+                     denominator,
+      ragged mixed : the same stream through the flat-stream mixed
+                     dispatch,
+      ragged equal : equal-length stream, ragged on — the packing
+                     reference; mixed-length serving must hold >= ~0.9x of
+                     it, since the ragged pack never pads lengths."""
+    import jax
+
+    from localai_tpu.engine.loader import load_config, load_params
+    from localai_tpu.ops.paged import BLOCK
+
+    tmp = tempfile.mkdtemp(prefix="bench-ckpt-")
+    ckpt = write_synthetic_checkpoint(size, os.path.join(tmp, size))
+    os.environ["LOCALAI_ALLOW_SYNTHETIC"] = "1"
+    dtype = args.dtype or ("int8" if size == "8b" else "bfloat16")
+    if on_cpu:
+        dtype = args.dtype or "float32"
+    cfg = load_config(ckpt, dtype=dtype)
+    context = min(args.context, cfg.max_position)
+    params = load_params(ckpt, cfg, dtype=dtype)
+    jax.block_until_ready(params)
+    note("params initialized")
+
+    tokens = min(args.prompt_len * 3 // 2 + args.decode_steps + 33, context)
+    pages = args.kv_pages or args.slots * (-(-tokens // BLOCK)) + 1
+    budget = args.ragged_budget or args.slots * 8 + 128
+    note(f"pool {pages} blocks, token budget {budget} rows")
+
+    dense = _ragged_leg(args, cfg, params, context, pages, 0, mixed=True)
+    note(f"dense mixed: {dense['tok_s']:.1f} tok/s, "
+         f"ttft p50 {dense['ttft_p50_ms']:.0f}ms")
+    ragged = _ragged_leg(args, cfg, params, context, pages, budget,
+                         mixed=True)
+    note(f"ragged mixed: {ragged['tok_s']:.1f} tok/s "
+         f"({ragged['tok_s'] / max(dense['tok_s'], 1e-9):.2f}x dense), "
+         f"ttft p50 {ragged['ttft_p50_ms']:.0f}ms, "
+         f"budget util {ragged['budget_utilization']:.2f}")
+    equal = _ragged_leg(args, cfg, params, context, pages, budget,
+                        mixed=False)
+    note(f"ragged equal: {equal['tok_s']:.1f} tok/s (mixed holds "
+         f"{ragged['tok_s'] / max(equal['tok_s'], 1e-9):.2f}x of it)")
+    import shutil
+
+    shutil.rmtree(tmp, ignore_errors=True)
+    return dense, ragged, equal, pages, budget, context, dtype
+
+
 def bench_embed(args, size: str, on_cpu: bool):
     """BASELINE config #3: /v1/embeddings-path throughput (served gRPC
     Embedding RPC, batch inputs) → embeddings/s."""
@@ -695,13 +830,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="tiny|1b|3b|8b (default: 8b on TPU, tiny on CPU)")
     p.add_argument("--mode", default="serve",
                    choices=["serve", "engine", "embed", "whisper", "paged",
-                            "tp"],
+                            "tp", "ragged"],
                    help="serve = gRPC backend subprocess (default); engine = "
                         "in-process; paged = dense AND paged in one process "
                         "with a paged_over_dense ratio; tp = single device "
                         "AND an N-device tensor-parallel mesh in one process "
                         "with a tp_over_single ratio (CPU: virtual 4-device "
-                        "mesh); embed/whisper = BASELINE configs #3/#4")
+                        "mesh); ragged = mixed-length continuous batching "
+                        "through the flat-stream dispatch, three legs "
+                        "(dense mixed / ragged mixed / ragged equal) with "
+                        "ragged_over_dense + mixed_over_equal ratios; "
+                        "embed/whisper = BASELINE configs #3/#4")
     p.add_argument("--embed-batch", type=int, default=256)
     p.add_argument("--dtype", default=None,
                    help="override weights dtype (default: int8 for 8b, else bf16)")
@@ -717,6 +856,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="max steps per single-dispatch while-loop decode "
                         "block (engine mode; default: engine's 64; 0 "
                         "disables the loop — scan-ladder comparison runs)")
+    p.add_argument("--ragged-budget", type=int, default=0,
+                   help="ragged token rows per mixed dispatch (--mode "
+                        "ragged; 0 = auto: slots*8 + 128 — every decode "
+                        "slot plus one 128-token prefill chunk)")
     p.add_argument("--kv-pages", type=int, default=0,
                    help="paged KV pool size in 128-token blocks "
                         "(0 = dense per-slot cache); lets slot count "
@@ -887,6 +1030,51 @@ def main(argv=None):
             "device": device_kind,
             "params": n_params,
             **stats,
+        }
+        if on_cpu and not args.cpu:
+            result["probe_error"] = probe_error[:500]
+        return emit_result(result, args)
+    if args.mode == "ragged":
+        import jax
+
+        if on_cpu:
+            jax.config.update("jax_platforms", "cpu")
+        note("initializing device client...")
+        dev = jax.devices()[0]
+        device_kind = getattr(dev, "device_kind", dev.platform)
+        dense, ragged, equal, pages, budget, context, dtype = bench_ragged(
+            args, size, on_cpu)
+        toks_per_s = ragged["tok_s"]
+        n_params = param_count(size)
+        mfu = (toks_per_s * 2 * n_params) / peak_flops_per_chip(device_kind)
+        result = {
+            "metric": f"serve tok/s (llama-{size} {dtype}, ragged "
+                      f"mixed-length vs dense, {args.slots} slots, "
+                      f"budget {budget} rows, ctx {context})",
+            "value": round(toks_per_s, 2),
+            "unit": "tok/s",
+            "vs_baseline": None if on_cpu else round(toks_per_s / 1000.0, 4),
+            "dense_tok_s": round(dense["tok_s"], 2),
+            "equal_len_tok_s": round(equal["tok_s"], 2),
+            "ragged_over_dense": round(
+                toks_per_s / max(dense["tok_s"], 1e-9), 4),
+            "mixed_over_equal": round(
+                toks_per_s / max(equal["tok_s"], 1e-9), 4),
+            "ttft_p50_ms": round(ragged["ttft_p50_ms"], 2),
+            "ttft_p95_ms": round(ragged["ttft_p95_ms"], 2),
+            "dense_ttft_p50_ms": round(dense["ttft_p50_ms"], 2),
+            "dense_ttft_p95_ms": round(dense["ttft_p95_ms"], 2),
+            "budget_utilization": ragged["budget_utilization"],
+            "ragged_dispatches": int(
+                ragged["metrics"].get("ragged_dispatches", 0)),
+            "mesh": None,
+            "chips": 1,
+            "tok_s_global": round(toks_per_s, 2),
+            "tok_s_per_chip": round(toks_per_s, 2),
+            "mfu": None if on_cpu else round(mfu, 4),
+            "device": device_kind,
+            "params": n_params,
+            **dispatch_stats(ragged["metrics"]),
         }
         if on_cpu and not args.cpu:
             result["probe_error"] = probe_error[:500]

@@ -106,6 +106,22 @@ class EngineConfig:
                                   # reserve ceil((prompt+max_tokens)/128)
                                   # blocks at admission, so the pool
                                   # oversubscribes max_context, not requests.
+    ragged_token_budget: int = 0  # ragged continuous batching (paged KV
+                                  # only): token rows packed per mixed tick.
+                                  # When > 0, ticks with prefill work pack
+                                  # ALL live decode slots (one row each) plus
+                                  # chunked-prefill windows into ONE flat
+                                  # stream and run a single ragged-attention
+                                  # dispatch (ops/pallas/ragged_attention.py)
+                                  # — no per-bucket padding, no separate
+                                  # prefill+decode programs on mixed ticks.
+                                  # Admission becomes host-only bookkeeping
+                                  # (never stalls on a device prefill); pure-
+                                  # decode ticks keep the fused while-loop
+                                  # path. 0 disables (the default serving
+                                  # paths are untouched). Rounded up to a
+                                  # QBLK (8-row) multiple; grammar slots and
+                                  # multimodal windows keep the dense paths.
     max_restarts: int = 2         # fatal step() errors survived per engine
                                   # lifetime: in-flight streams fail, device
                                   # state is rebuilt, new requests serve
@@ -281,6 +297,22 @@ class Engine:
         if self._paged:
             if self.ec.kv_pages < 2:
                 raise ValueError("kv_pages must be >= 2 (block 0 is trash)")
+        # ragged continuous batching: one flat-stream dispatch for mixed
+        # prefill+decode ticks (models/llama.ragged_forward). Paged-pool
+        # only — the flat KV writes resolve through block tables.
+        self._ragged = self.ec.ragged_token_budget > 0
+        if self._ragged:
+            if not self._paged:
+                raise ValueError(
+                    "ragged_token_budget requires paged KV (set kv_pages)")
+            if self._draft is not None:
+                raise ValueError(
+                    "ragged continuous batching is incompatible with a "
+                    "draft model (speculation has its own fused program)")
+            from localai_tpu.ops.pallas import QBLK
+
+            rows = max(self.ec.ragged_token_budget, 2 * QBLK)
+            self._ragged_rows = -(-rows // QBLK) * QBLK
         if self._draft is not None and self._draft[0].vocab_size != V:
             raise ValueError("draft vocab differs from target")
         self._kv_dtype = dtype
@@ -348,6 +380,12 @@ class Engine:
         if self._draft is not None:
             self.metrics["draft_proposed"] = 0
             self.metrics["draft_accepted"] = 0
+        if self._ragged:
+            # token-budget utilization = ragged_tokens_packed /
+            # (ragged_dispatches * ragged rows) — how full the flat stream
+            # runs (bench.py --mode ragged reports it)
+            self.metrics["ragged_dispatches"] = 0
+            self.metrics["ragged_tokens_packed"] = 0
 
         # telemetry (localai_tpu/telemetry): both gates resolve to None/False
         # here so the per-dispatch cost of a disabled build is one attribute
@@ -397,6 +435,9 @@ class Engine:
         self._deferred: tuple | None = None   # admission waiting on blocks
         self._admitting: tuple | None = None  # admission mid-device-call
         self._blocks_freed = False
+        self._ragged_rr = 0   # ragged decode-row round-robin offset (fair
+                              # rotation when the token budget can't hold
+                              # every live slot in one tick)
 
         with activate_mesh(self.mesh):
             cos, sin = rope_table(cfg.rope, T)
@@ -727,6 +768,51 @@ class Engine:
                 _loop, donate_argnums=(3, 4, 5, 6, 7),
                 static_argnames=("fast_width",))
 
+        # standalone sampler-row install: the ragged path defers a final
+        # chunk's row to its own small dispatch (the ragged program's
+        # signature stays row-structure-free, so it compiles exactly once)
+        self._install_fn = jax.jit(_install_row, donate_argnums=(0,))
+
+        # ragged mixed-tick program: sample all slots from last_logits,
+        # splice the sampled tokens into the packed flat stream at the
+        # decode rows, then ONE ragged forward covers every decode slot and
+        # prefill chunk (models/llama.ragged_forward). Per-slot RNG/count
+        # semantics mirror _decode exactly — topk_width=None draws the same
+        # tokens as any fast-width tier (ops/sampling._draw is width-
+        # independent), so ragged and dense serving emit identical streams.
+        self._ragged_fn = None
+        if self._ragged:
+            from localai_tpu.models.llama import ragged_forward
+
+            def _ragged_step(params, cos, sin, kc, vc, sampler, last_logits,
+                             lengths, tokens_flat, decode_slot, is_decode,
+                             set_len, logit_set, logit_rows, block_seq,
+                             qstart, qlen, kvlen, table):
+                sampled, keys, logprobs = sample(last_logits, sampler, None,
+                                                 topk_width=None)
+                toks = jnp.where(decode_slot >= 0,
+                                 sampled[jnp.maximum(decode_slot, 0)],
+                                 tokens_flat)
+                logits, kc, vc = ragged_forward(
+                    params, cfg, toks, cos, sin, kc, vc, block_seq, qstart,
+                    qlen, kvlen, table, logit_rows)
+                act = is_decode.astype(jnp.int32)
+                counts = sampler.token_counts.at[
+                    jnp.arange(sampled.shape[0]), sampled].add(act)
+                sampler = dataclasses.replace(sampler, key=keys,
+                                              token_counts=counts)
+                # decode slots and final prefill chunks pick up their new
+                # last-token logits; mid-chunk and idle slots hold theirs
+                last_logits = jnp.where(logit_set[:, None], logits,
+                                        last_logits)
+                lengths = jnp.where(set_len >= 0, set_len, lengths + act)
+                return (constrain(sampled, P(None)),
+                        constrain(logprobs, P(None)),
+                        kc, vc, sampler, last_logits, lengths)
+
+            self._ragged_fn = jax.jit(_ragged_step,
+                                      donate_argnums=(3, 4, 5, 6, 7))
+
     # ------------------------------------------------------ device dispatch
     # Every device call goes through one of these. On a multi-host mesh the
     # rank-0 engine broadcasts (op, args) over the Replicator side channel
@@ -943,6 +1029,53 @@ class Engine:
                   fence=toks, fast_width=fast_width or 0)
         return _AsyncFetch((toks, lps, n_out, steps))
 
+    def _dev_ragged(self, pack):
+        """ONE flat-stream dispatch for a mixed tick: every live decode slot
+        (one sampled token each) plus packed chunked-prefill windows run a
+        single ragged-attention forward. `pack` is the host-built metadata
+        (see _ragged_tick); `packed` counts the live token rows for the
+        budget-utilization metric."""
+        self.metrics["decode_dispatches"] += 1
+        self.metrics["decode_steps_dispatched"] += 1
+        self.metrics["ragged_dispatches"] = (
+            self.metrics.get("ragged_dispatches", 0) + 1)
+        self.metrics["ragged_tokens_packed"] = (
+            self.metrics.get("ragged_tokens_packed", 0)
+            + int(pack["packed"]))
+        t0 = time.perf_counter()
+        self._bcast("ragged", **pack)
+        with activate_mesh(self.mesh), self._decode_guard():
+            (tokens, logprobs, self._kc, self._vc, self._sampler,
+             self._last_logits, self._lengths) = self._ragged_fn(
+                self.params, self._cos, self._sin, self._kc, self._vc,
+                self._sampler, self._last_logits, self._lengths,
+                jnp.asarray(pack["tokens"]),
+                jnp.asarray(pack["decode_slot"]),
+                jnp.asarray(pack["is_decode"]),
+                jnp.asarray(pack["set_len"]),
+                jnp.asarray(pack["logit_set"]),
+                jnp.asarray(pack["logit_rows"]),
+                jnp.asarray(pack["block_seq"]),
+                jnp.asarray(pack["qstart"]), jnp.asarray(pack["qlen"]),
+                jnp.asarray(pack["kvlen"]), self._tab())
+        self._obs("ragged", t0, tokens=int(pack["packed"]), fence=tokens)
+        return _AsyncFetch((tokens, logprobs))
+
+    def _dev_install(self, idx, row, counts_row):
+        """Sampler-row install for a ragged final prefill chunk (the dense
+        path installs inside _extend_final; the ragged program defers it
+        here so its own signature stays row-structure-free)."""
+        t0 = time.perf_counter()
+        self._bcast("install", idx=idx,
+                    row={k: np.asarray(v) for k, v in row.items()},
+                    counts_row=counts_row)
+        with activate_mesh(self.mesh):
+            self._sampler = self._install_fn(
+                self._sampler, jnp.int32(idx),
+                {k: jnp.asarray(v) for k, v in row.items()},
+                None if counts_row is None else jnp.asarray(counts_row))
+        self._obs("install", t0, slot=int(idx))
+
     def _dev_shift(self, idx):
         t0 = time.perf_counter()
         self._bcast("shift", idx=idx)
@@ -1051,6 +1184,10 @@ class Engine:
         elif op == "decode_loop":
             self._dev_decode_loop(kw["active"], kw["remaining"],
                                   kw["check_eos"], kw.get("fast_width"))
+        elif op == "ragged":
+            self._dev_ragged(kw)
+        elif op == "install":
+            self._dev_install(kw["idx"], kw["row"], kw["counts_row"])
         elif op == "shift":
             self._dev_shift(kw["idx"])
         elif op == "draft_ingest":
@@ -1194,6 +1331,13 @@ class Engine:
             ))
             return False
         mm = req.mm_embeds is not None
+        if self._ragged and not mm:
+            # ragged admissions are always chunked: admission itself becomes
+            # host-only slot bookkeeping, and the prompt is packed unpadded
+            # into mixed ragged ticks — no bucket padding, no admission-time
+            # device dispatch (multimodal keeps the dense path: feature
+            # injection is outside the flat-stream program)
+            chunked, bucket = True, None
         # multimodal: id-level prefix reuse would match the repeated image
         # token while the injected features differ — no slot or disk reuse
         slot, lcp = self._pick_slot([] if mm else req.prompt_ids)
@@ -1334,8 +1478,16 @@ class Engine:
 
     def _prefill_drain(self, budget: int, pending: list):
         for _ in range(budget):
-            if self._prefillq:
-                idx = self._prefillq[0]
+            pq = self._prefillq
+            if self._ragged_now():
+                # ragged mode packs token-level prefill into mixed ragged
+                # ticks (_ragged_tick); only multimodal prompts — excluded
+                # from the flat-stream program by their feature injection —
+                # still take the dense chunked path here
+                pq = [i for i in self._prefillq
+                      if self._slots[i].req.mm_embeds is not None]
+            if pq:
+                idx = pq[0]
                 slot = self._slots[idx]
                 ids = slot.req.prompt_ids
                 pos = slot.prefill_pos
@@ -1355,7 +1507,7 @@ class Engine:
                 slot.prefill_pos = pos + nvalid
                 if final:
                     slot.prefilled = True
-                    self._prefillq.pop(0)
+                    self._prefillq.remove(idx)
                     if self._draft is not None:
                         tok, lp = self._dev_spec_admit_tail(idx)
                         self._emit(idx, slot, tok, lp, time.monotonic())
@@ -1748,6 +1900,146 @@ class Engine:
         return (any(s is not None for s in self._slots)
                 or not self._queue.empty() or self._deferred is not None)
 
+    # ------------------------------------------------------ ragged scheduling
+
+    def _ragged_now(self) -> bool:
+        """True when this tick may run the ragged mixed-dispatch path.
+        Grammar slots need a per-token host round trip (PDA mask advance)
+        which the flat-stream program has no lane for — dense ticks drain
+        them, then ragged resumes."""
+        return self._ragged and self._grammar_slots == 0
+
+    def _ragged_chunkable(self) -> list[int]:
+        """Prefill-queue slots whose next chunk can ride the flat stream
+        (multimodal prompts stay on the dense extend path — feature
+        injection is outside the flat-stream program)."""
+        return [i for i in self._prefillq
+                if self._slots[i] is not None
+                and self._slots[i].req.mm_embeds is None]
+
+    def _step_ragged(self) -> bool:
+        """Run one mixed ragged tick if there is prefill work to pack with
+        the running decodes. Returns False to fall through to the dense
+        tick — pure decode keeps the single-dispatch while-loop, which a
+        mixed program cannot beat when there is nothing to mix."""
+        admissible = ((not self._queue.empty() and bool(self._free))
+                      or (self._deferred is not None and self._blocks_freed))
+        if not self._ragged_chunkable() and not admissible:
+            return False
+        # host lengths must be exact before packing (loop dispatches have
+        # data-dependent step counts): consume the in-flight dispatch first.
+        # The ragged dispatch below is consumed synchronously in-tick, so
+        # the pipeline resumes cleanly on the next pure-decode tick.
+        if self._pending is not None:
+            self._consume(self._pending)
+            self._pending = None
+        self._prefill_tick()   # ragged admissions land chunked (host-only)
+        chunkable = self._ragged_chunkable()
+        if not chunkable:
+            return False       # only mm prompts queued: dense tick serves
+        self._ragged_tick(chunkable)
+        return True
+
+    def _ragged_tick(self, chunkable: list[int]):
+        """Pack every live decode slot plus as many prefill-chunk tokens as
+        fit into ONE flat [T] token stream and dispatch a single ragged
+        forward. Layout contract (ops/pallas/ragged_attention): each
+        QBLK-row q block belongs to exactly one sequence; a decode slot
+        occupies one live row + QBLK-1 dead pad rows; a prefill chunk spans
+        ceil(n/QBLK) blocks. Seq index == engine slot index, so the device
+        derives every per-row position and page target from the engine's
+        own block table — no remapping, no bucket padding."""
+        from localai_tpu.ops.pallas import QBLK
+        B = self.ec.max_slots
+        T = self._ragged_rows
+        block_seq = np.full((T // QBLK,), -1, np.int32)
+        tokens = np.zeros((T,), np.int32)
+        decode_slot = np.full((T,), -1, np.int32)
+        qstart = np.zeros((B,), np.int32)
+        qlen = np.zeros((B,), np.int32)
+        kvlen = np.zeros((B,), np.int32)
+        set_len = np.full((B,), -1, np.int32)
+        logit_set = np.zeros((B,), bool)
+        is_decode = np.zeros((B,), bool)
+        logit_rows = np.zeros((B,), np.int32)
+        row = 0
+        entries = []
+        # Decode packing: one QBLK-aligned row per prefilled slot. One QBLK
+        # is always reserved for prefill so admission can't be starved by a
+        # full decode population; when the budget can't hold every slot the
+        # rotating offset keeps the overflow fair across ticks.
+        cap = T - QBLK
+        order = [(self._ragged_rr + j) % B for j in range(B)]
+        self._ragged_rr = (self._ragged_rr + 1) % max(B, 1)
+        for i in order:
+            s = self._slots[i]
+            if s is None or not s.prefilled:
+                continue
+            if row + QBLK > cap:
+                break
+            n = s.prompt_len + s.generated - s.shifted
+            qstart[i], qlen[i], kvlen[i] = row, 1, n + 1
+            block_seq[row // QBLK] = i
+            decode_slot[row] = i
+            is_decode[i] = True
+            logit_set[i] = True
+            logit_rows[i] = row
+            entries.append((i, s.request_id))
+            row += QBLK
+        packed = len(entries)
+        chunks = []
+        for idx in chunkable:
+            if T - row < QBLK:
+                break
+            s = self._slots[idx]
+            ids = s.req.prompt_ids
+            pos = s.prefill_pos
+            nvalid = min(len(ids) - pos, T - row, self._chunk)
+            tokens[row:row + nvalid] = ids[pos:pos + nvalid]
+            nb = -(-nvalid // QBLK)
+            block_seq[row // QBLK:row // QBLK + nb] = idx
+            final = pos + nvalid == len(ids)
+            qstart[idx], qlen[idx] = row, nvalid
+            kvlen[idx] = pos + nvalid
+            if final:
+                # device length is set only at the final chunk (mid chunks
+                # mirror extend_mid: host tracks prefill_pos, device length
+                # stays 0 so the slot can't be decoded early)
+                set_len[idx] = pos + nvalid
+                logit_set[idx] = True
+                logit_rows[idx] = row + nvalid - 1
+            chunks.append((idx, pos, nvalid, final))
+            packed += nvalid
+            row += nb * QBLK
+        pack = dict(tokens=tokens, decode_slot=decode_slot,
+                    is_decode=is_decode, set_len=set_len,
+                    logit_set=logit_set, logit_rows=logit_rows,
+                    block_seq=block_seq, qstart=qstart, qlen=qlen,
+                    kvlen=kvlen, packed=packed)
+        fetch = self._dev_ragged(pack)
+        for idx, pos, nvalid, final in chunks:
+            s = self._slots[idx]
+            s.prefill_pos = pos + nvalid
+            if final:
+                # sampler row rides a separate tiny dispatch so the ragged
+                # program's signature stays row-structure-free
+                self._dev_install(idx, s.row, s.counts_row)
+                s.prefilled = True
+                self._prefillq.remove(idx)
+        t0 = time.perf_counter()
+        tokens_out, logprobs = fetch.wait()
+        self.metrics["host_sync_wait_ms"] += (time.perf_counter() - t0) * 1e3
+        now = time.monotonic()
+        emitted = 0
+        for i, rid in entries:
+            s = self._slots[i]
+            if s is None or s.request_id != rid:
+                continue
+            self._emit(i, s, int(tokens_out[i]), float(logprobs[i]), now)
+            emitted += 1
+        self._obs("sample", t0, tokens=emitted, steps=1, rollbacks=0)
+        self._dispatch_gauges()
+
     def step(self) -> bool:
         """One engine iteration. In pipelined mode (the default, grammar-free)
         one decode step stays in flight: step N+1 is dispatched before step
@@ -1757,6 +2049,12 @@ class Engine:
         before the next sample). Returns True while work remains."""
         if self._draft is not None:
             return self._step_spec()
+        if self._ragged_now() and self._step_ragged():
+            # mixed tick: decode + prefill ran as one ragged dispatch,
+            # consumed synchronously (no pending survives a ragged tick)
+            return (any(s is not None for s in self._slots)
+                    or not self._queue.empty() or self._pending is not None
+                    or self._deferred is not None)
         sync = self._grammar_slots > 0 or not self.ec.pipeline
         if sync and self._pending is not None:
             self._consume(self._pending)
@@ -2277,12 +2575,32 @@ class Engine:
         B, V = self.ec.max_slots, self.cfg.vocab_size
         snap = {k: self.metrics[k] for k in (
             "decode_dispatches", "decode_steps_dispatched",
-            "host_sync_wait_ms")}
+            "host_sync_wait_ms") + (
+            ("ragged_dispatches", "ragged_tokens_packed")
+            if self._ragged else ())}
         idle = np.zeros((B,), bool)
         try:
             if self._draft is not None:
                 self._dev_spec_decode(idle).wait()
                 return
+            if self._ragged:
+                # one all-dead pack compiles the ragged program (its shapes
+                # are fixed: [T] stream + [B] metadata, so one trace covers
+                # every future mix of decode rows and prefill chunks)
+                T = self._ragged_rows
+                from localai_tpu.ops.pallas import QBLK
+                self._dev_ragged(dict(
+                    tokens=np.zeros((T,), np.int32),
+                    decode_slot=np.full((T,), -1, np.int32),
+                    is_decode=np.zeros((B,), bool),
+                    set_len=np.full((B,), -1, np.int32),
+                    logit_set=np.zeros((B,), bool),
+                    logit_rows=np.zeros((B,), np.int32),
+                    block_seq=np.full((T // QBLK,), -1, np.int32),
+                    qstart=np.zeros((B,), np.int32),
+                    qlen=np.zeros((B,), np.int32),
+                    kvlen=np.zeros((B,), np.int32),
+                    packed=0)).wait()
             widths = [None]
             W = self.ec.sampling_topk_width
             if W:

@@ -664,6 +664,146 @@ def decode_step(params, cfg: LlamaConfig, tokens, lengths, cos, sin,
     return logits, k_cache, v_cache
 
 
+def ragged_forward(params, cfg: LlamaConfig, tokens, cos, sin,
+                   k_cache, v_cache, block_seq, qstart, qlen, kvlen,
+                   tables, logit_rows):
+    """Mixed prefill+decode forward over ONE flat token stream (ragged
+    continuous batching, arXiv:2604.15464): decode tokens and chunked-prefill
+    windows from different requests pack into a single [T] stream and run as
+    one dispatch on the paged tier — no per-bucket padding, no separate
+    prefill and decode programs on mixed ticks.
+
+    tokens: [T] i32, T a multiple of ops.pallas.QBLK (8); every sequence's
+    rows start on a QBLK boundary (the engine packs this way) so each 8-row
+    kernel block belongs to exactly one sequence. Per-sequence metadata
+    ([NSEQ], padded with dead entries):
+      qstart[s]/qlen[s] — the sequence's row span in the stream (row units);
+      kvlen[s] — cache length INCLUDING this chunk (decode: old length + 1);
+      tables [NSEQ, MAXB] — block table into the paged pool;
+      block_seq [NQB=T/QBLK] — sequence id per q block, -1 for padding
+      blocks. logit_rows [NSEQ] — flat row of each sequence's last token
+      (decode rows and final prefill chunks; mid-prefill chunks may point
+      anywhere — their logits are ignored host-side).
+
+    Everything per-ROW (rope positions, scatter targets) derives on device
+    from that per-sequence metadata, so the host ships O(NSEQ) scalars, not
+    O(T). Padding rows write to the trash block (physical 0) and produce
+    garbage attention output that never reaches a logit row.
+
+    k_cache/v_cache: paged pools [L, NB, KVH, BS, D] (QuantKV int8 twin
+    supported). Returns (logits [NSEQ, V] f32, k_cache, v_cache). Tier
+    selection matches the decode path: Pallas ragged kernels on TPU (or
+    LOCALAI_FORCE_PALLAS), sharded per KV-head shard under a TP mesh, XLA
+    gather/scatter twins otherwise."""
+    from localai_tpu.ops.pallas import (
+        QBLK, ragged_attention_xla, ragged_attention_xla_q8,
+        ragged_paged_attention, ragged_paged_attention_q8,
+        ragged_paged_attention_q8_sharded, ragged_paged_attention_sharded,
+        ragged_scatter_append, ragged_scatter_append_q8,
+        ragged_scatter_append_q8_sharded, ragged_scatter_append_sharded,
+        ragged_scatter_xla, ragged_scatter_xla_q8,
+    )
+
+    t = tokens.shape[0]
+    kv_quant = isinstance(k_cache, QuantKV)
+    blk = (k_cache.q if kv_quant else k_cache).shape[3]        # pool BS
+    use_kernel = _pallas_paged_scatter(cfg, kv_quant)
+    mesh = None
+    if use_kernel:
+        from localai_tpu.parallel.mesh import current_mesh
+
+        mesh = current_mesh()
+    block_seq = block_seq.astype(jnp.int32)
+    qstart, qlen = qstart.astype(jnp.int32), qlen.astype(jnp.int32)
+    kvlen = kvlen.astype(jnp.int32)
+
+    # per-row derivations (device-side, from per-seq metadata): sequence id,
+    # liveness, absolute position, and the (physical block, in-block row)
+    # scatter target. Dead rows target trash (block 0) at per-row offsets —
+    # collisions there only overwrite other dead rows.
+    rows = jnp.arange(t, dtype=jnp.int32)
+    sid = block_seq[rows // QBLK]
+    s = jnp.maximum(sid, 0)
+    live = (sid >= 0) & (rows >= qstart[s]) & (rows < qstart[s] + qlen[s])
+    pos = kvlen[s] - qlen[s] + (rows - qstart[s])
+    pos = jnp.where(live, jnp.clip(pos, 0, cos.shape[0] - 1), 0)
+    pb = jnp.where(live, tables[s, pos // blk], 0)
+    off = jnp.where(live, pos % blk, rows % blk)
+
+    def write(kc, vc, kn, vn):
+        if use_kernel and kv_quant:
+            if mesh is not None:
+                kq, ks, vq, vs = ragged_scatter_append_q8_sharded(
+                    mesh, kc.q, kc.s, vc.q, vc.s, kn, vn, pb, off)
+            else:
+                kq, ks, vq, vs = ragged_scatter_append_q8(
+                    kc.q, kc.s, vc.q, vc.s, kn, vn, pb, off)
+            return QuantKV(kq, ks), QuantKV(vq, vs)
+        if use_kernel:
+            if mesh is not None:
+                return ragged_scatter_append_sharded(mesh, kc, vc, kn, vn,
+                                                     pb, off)
+            return ragged_scatter_append(kc, vc, kn, vn, pb, off)
+        if kv_quant:
+            kq, ks, vq, vs = ragged_scatter_xla_q8(
+                kc.q, kc.s, vc.q, vc.s, kn, vn, pb, off)
+            return QuantKV(kq, ks), QuantKV(vq, vs)
+        return ragged_scatter_xla(kc, vc, kn, vn, pb, off)
+
+    def attend(qf, kc, vc):
+        sw = cfg.sliding_window
+        if use_kernel and kv_quant:
+            if mesh is not None:
+                return ragged_paged_attention_q8_sharded(
+                    mesh, qf, kc.q, kc.s, vc.q, vc.s, block_seq, qstart,
+                    qlen, kvlen, tables, sliding_window=sw)
+            return ragged_paged_attention_q8(
+                qf, kc.q, kc.s, vc.q, vc.s, block_seq, qstart, qlen, kvlen,
+                tables, sliding_window=sw)
+        if use_kernel:
+            if mesh is not None:
+                return ragged_paged_attention_sharded(
+                    mesh, qf, kc, vc, block_seq, qstart, qlen, kvlen,
+                    tables, sliding_window=sw)
+            return ragged_paged_attention(qf, kc, vc, block_seq, qstart,
+                                          qlen, kvlen, tables,
+                                          sliding_window=sw)
+        if kv_quant:
+            return ragged_attention_xla_q8(
+                qf, kc.q, kc.s, vc.q, vc.s, block_seq, qstart, qlen, kvlen,
+                tables, sliding_window=sw)
+        return ragged_attention_xla(qf, kc, vc, block_seq, qstart, qlen,
+                                    kvlen, tables, sliding_window=sw)
+
+    x = params["embed"].astype(cfg.jdtype)[tokens][None]       # [1, T, H]
+
+    def layer(x, xs):
+        lp, kc, vc = xs
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+        q, k, v = _qkv(h, lp, cfg, spec=P(None, None, "model"))
+        q = apply_rope(q, cos, sin, pos[None])
+        k = apply_rope(k, cos, sin, pos[None])
+        q = _shard_act(q, P(None, None, "model", None))
+        # current chunk lands in the pool FIRST (decode_step convention:
+        # attention then reads it back through the table — kvlen already
+        # counts it), so prefill chunks attend to themselves paged
+        kc, vc = write(kc, vc, k[0], v[0])
+        attn = attend(q[0], kc, vc)
+        x = x + qmatmul(attn.reshape(1, t, -1), lp["wo"],
+                        spec=P(None, None, None))
+        h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+        x = x + _mlp(h, lp, cfg, spec_prefix=(None, None))
+        return x, (kc, vc)
+
+    x, (k_cache, v_cache) = jax.lax.scan(
+        layer, x, (params["layers"], k_cache, v_cache)
+    )
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    last = x[0][logit_rows.astype(jnp.int32)]                  # [NSEQ, H]
+    logits = _lm_head(last.astype(jnp.float32), params)
+    return logits, k_cache, v_cache
+
+
 def build_decode_loop(step_fn, *, max_steps: int, limit: int):
     """While-loop variant of the fused decode block (Kernel Looping,
     arXiv:2410.23668): up to `max_steps` sample→decode iterations run as ONE

@@ -241,15 +241,31 @@ def sample(logits, state: SamplerState, mask_bits=None, topk_width=None):
 
 
 def _draw(state: SamplerState, masked):
-    """Shared PRNG step: split per-slot keys, draw a categorical rank from
-    the masked (NEG_INF-dropped) logits, greedy rows take rank 0.
+    """Shared PRNG step: split per-slot keys, invert the masked categorical's
+    CDF at ONE scalar uniform per slot, greedy rows take rank 0.
+
+    jax.random.categorical would be the obvious draw, but its Gumbel-max
+    trick consumes randomness per LANE: the same key over a [B, V] full-sort
+    row and a [B, W] top-k window yields different tokens even when the
+    survivor distributions are identical, so escalating a slot onto the
+    sort-free fast path silently changed its sampled stream. A scalar
+    uniform + inverse CDF is width-independent by construction — dropped
+    lanes sit at NEG_INF, carry exactly zero probability mass, and cannot
+    move the threshold count.
     Returns (sampled_rank [B], carry_keys [B,2] u32)."""
     new_keys = jax.vmap(lambda kk: jax.random.split(
         jax.random.wrap_key_data(kk), 2))(state.key)
     step_keys = jax.vmap(jax.random.wrap_key_data)(
         jax.vmap(jax.random.key_data)(new_keys[:, 1]))
-    sampled_rank = jax.vmap(lambda kk, lg: jax.random.categorical(kk, lg))(
-        step_keys, masked)
+    u = jax.vmap(lambda kk: jax.random.uniform(kk, ()))(step_keys)
+    # unnormalized weights: exp(NEG_INF - max) underflows to exactly 0, so
+    # the cumsum prefix over the survivors is identical across widths
+    w = jnp.exp(masked - masked[:, :1])      # rank 0 always survives
+    cum = jnp.cumsum(w, axis=-1)
+    r = u[:, None] * cum[:, -1:]
+    # smallest rank with cum >= r; the constant tail (cum == total >= r)
+    # never counts, so the rank stays within the survivor prefix
+    sampled_rank = jnp.sum((cum < r).astype(jnp.int32), axis=-1)
     sampled_rank = jnp.where(state.greedy, 0, sampled_rank)
     carry_keys = jax.vmap(jax.random.key_data)(new_keys[:, 0]).astype(
         jnp.uint32)

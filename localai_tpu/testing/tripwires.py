@@ -59,7 +59,7 @@ def transfer_guard(level: str = "disallow"):
 DECODE_FN_ATTRS = (
     "_decode_fn", "_decode_nomask_fn", "_decode_fast_fn",
     "_decode_block_fn", "_decode_block_mask_fn", "_decode_loop_fn",
-    "_spec_fn",
+    "_spec_fn", "_ragged_fn",
 )
 
 
@@ -101,8 +101,14 @@ def dispatch_budget(engine, max_per_128_tokens: float = 3.0):
     paths without instrumentation."""
     m = engine.metrics
     d0, t0 = m["decode_dispatches"], m["tokens_generated"]
+    r0 = m.get("ragged_dispatches", 0)
     yield
-    dispatches = m["decode_dispatches"] - d0
+    # mixed-tick ragged dispatches are exempt: each one serves EVERY live
+    # decode slot plus a prefill chunk in a single program, so counting
+    # them against the decode-loop fusing budget would penalize exactly
+    # the consolidation this guard exists to protect
+    dispatches = (m["decode_dispatches"] - d0) \
+        - (m.get("ragged_dispatches", 0) - r0)
     tokens = m["tokens_generated"] - t0
     allowed = max(1, math.ceil(tokens / 128.0 * max_per_128_tokens))
     if dispatches > allowed:

@@ -437,6 +437,7 @@ def test_realtime_websocket_text_session(stack):
     import io
     import wave
 
+    pytest.importorskip("websockets")
     from websockets.sync.client import connect
 
     base, _ = stack
@@ -476,6 +477,7 @@ def test_realtime_response_cancel(stack):
     """response.cancel interrupts an in-flight response: the terminal event
     is response.done with status cancelled (the reference stubs this,
     realtime.go:522 — we implement it)."""
+    pytest.importorskip("websockets")
     from websockets.sync.client import connect
 
     base, _ = stack
@@ -511,6 +513,7 @@ def test_realtime_transcription_session(stack):
     NO response events; response.create is rejected; buffer.clear works."""
     import base64
 
+    pytest.importorskip("websockets")
     from websockets.sync.client import connect
 
     from localai_tpu.audio.tts import synthesize

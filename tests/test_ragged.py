@@ -1,0 +1,459 @@
+"""Ragged continuous batching (one paged-attention dispatch for mixed
+prefill + decode):
+
+- model-level parity: a mixed ragged tick (1-token decode rows beside a
+  long prefill chunk in ONE flat stream) reproduces the dense decode_step
+  and dense chunked prefill bit-for-bit on the f32 tier — logits AND pool
+  contents — on both the XLA reference tier and the Pallas kernels
+  (interpret mode), plus lenient-parity twins for the bf16 and int8-KV
+  pools,
+- TP: the shard_map wrappers (attention + scatter, f32 and q8) match the
+  unsharded reference on the 4-device mesh,
+- engine-level parity: a mixed-length request stream through a ragged
+  engine (`ragged_token_budget`) produces token streams identical to the
+  dense paged engine, and admission packs its first prefill chunk into a
+  ragged dispatch in the SAME tick,
+- structural proofs: the compiled ragged forward contains no gather/
+  scatter over the full KV pool on the Pallas tier (the detector fires on
+  the XLA tier, so it has teeth), and its activation footprint scales with
+  the packed token budget, NOT with the slot count — the no-bucket-padding
+  property that makes 256-slot serving affordable.
+"""
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from fixtures import tiny_checkpoint
+from localai_tpu.engine import (
+    Engine, EngineConfig, GenRequest, Tokenizer, load_config, load_params,
+)
+from localai_tpu.models.llama import (
+    LlamaConfig, decode_step, init_params, prefill, ragged_forward,
+)
+from localai_tpu.ops.paged import BLOCK, init_paged
+from localai_tpu.ops.rope import rope_table
+from localai_tpu.ops.sampling import SamplingParams
+
+pytestmark = pytest.mark.ragged
+
+TINY = LlamaConfig(
+    vocab_size=256, hidden_size=64, intermediate_size=128, num_layers=2,
+    num_heads=4, num_kv_heads=2, head_dim=16, max_position=256,
+    dtype="float32",
+)
+
+
+def _tier(monkeypatch, tier):
+    if tier == "pallas":
+        monkeypatch.setenv("LOCALAI_FORCE_PALLAS", "1")
+        monkeypatch.delenv("LOCALAI_NO_PALLAS", raising=False)
+    else:
+        monkeypatch.setenv("LOCALAI_NO_PALLAS", "1")
+        monkeypatch.delenv("LOCALAI_FORCE_PALLAS", raising=False)
+
+
+def _mixed_tick(cache_type="", dtype=jnp.float32, cfg=None):
+    """Dense reference vs one ragged mixed tick over the same pool: decode
+    slots A (kv 5) and B (kv 7) ride 1-token QBLK rows while slot C's
+    12-token prefill chunk packs behind them. Returns (ragged logits,
+    dense decode logits, dense prefill-C logits, ragged pool, dense decode
+    pool, dense prefill pool)."""
+    cfg = cfg or TINY
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    cos, sin = rope_table(cfg.rope, 256)
+    kc, vc = init_paged(cfg.num_layers, 10, cfg.num_kv_heads, cfg.head_dim,
+                        dtype, cache_type=cache_type)
+    table = jnp.array([[1, 2], [3, 4], [5, 6]], jnp.int32)
+    pa = jax.random.randint(jax.random.PRNGKey(1), (1, 5), 0, 256)
+    pb = jax.random.randint(jax.random.PRNGKey(2), (1, 7), 0, 256)
+    pc = jax.random.randint(jax.random.PRNGKey(3), (1, 12), 0, 256)
+    la, kc, vc = prefill(params, cfg, pa, jnp.array([5]), cos, sin, kc, vc,
+                         jnp.array([0]), table=table)
+    lb, kc, vc = prefill(params, cfg, pb, jnp.array([7]), cos, sin, kc, vc,
+                         jnp.array([1]), table=table)
+    ta = jnp.argmax(la, -1).astype(jnp.int32)[0]
+    tb = jnp.argmax(lb, -1).astype(jnp.int32)[0]
+    dl, kc_d, vc_d = decode_step(
+        params, cfg, jnp.array([ta, tb, 0]), jnp.array([5, 7, 0], jnp.int32),
+        cos, sin, kc, vc, active=jnp.array([True, True, False]), table=table)
+    lc, kc_c, _ = prefill(params, cfg, pc, jnp.array([12]), cos, sin, kc, vc,
+                          jnp.array([2]), table=table)
+    tokens = jnp.zeros((32,), jnp.int32)
+    tokens = tokens.at[0].set(ta).at[8].set(tb).at[16:28].set(pc[0])
+    rl, kc_r, _ = ragged_forward(
+        params, cfg, tokens, cos, sin, kc, vc,
+        block_seq=jnp.array([0, 1, 2, 2], jnp.int32),
+        qstart=jnp.array([0, 8, 16], jnp.int32),
+        qlen=jnp.array([1, 1, 12], jnp.int32),
+        kvlen=jnp.array([6, 8, 12], jnp.int32),
+        tables=table, logit_rows=jnp.array([0, 8, 27], jnp.int32))
+    return rl, dl, lc, kc_r, kc_d, kc_c
+
+
+# tier-1 keeps the pallas (chip-kernel) tier; the XLA-reference tier rides
+# the slow lane — the engine stream tests prove that path end to end with
+# exact token parity, and the single-core tier-1 wall clock is budget-bound
+@pytest.mark.parametrize("tier", [
+    pytest.param("xla", marks=pytest.mark.slow),
+    "pallas",
+])
+def test_mixed_tick_matches_dense(monkeypatch, tier):
+    """Acceptance: ONE ragged dispatch == dense decode_step + dense prefill
+    over the same paged pool — logits and written pool blocks identical."""
+    _tier(monkeypatch, tier)
+    rl, dl, lc, kc_r, kc_d, kc_c = _mixed_tick()
+    np.testing.assert_allclose(np.asarray(rl[:2]), np.asarray(dl[:2]),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(rl[2]), np.asarray(lc[0]),
+                               rtol=2e-4, atol=2e-4)
+    # decode writes (A at row 5 of block 1, B at row 7 of block 3) and the
+    # chunk's writes (C rows 0..11 of block 5) match the dense paths
+    np.testing.assert_allclose(np.asarray(kc_r[:, 1, :, :6]),
+                               np.asarray(kc_d[:, 1, :, :6]), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(kc_r[:, 3, :, :8]),
+                               np.asarray(kc_d[:, 3, :, :8]), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(kc_r[:, 5, :, :12]),
+                               np.asarray(kc_c[:, 5, :, :12]), atol=1e-5)
+
+
+# quantized-pool tiers ride the slow lane (resilience-suite precedent:
+# tier-1 keeps the cheap core proofs on the 870s single-core budget; the
+# slow CI job runs the full matrix)
+@pytest.mark.slow
+@pytest.mark.parametrize("tier", ["xla", "pallas"])
+def test_mixed_tick_q8_pool(monkeypatch, tier):
+    """int8-KV twin: quantized pools dequantize+reduce in tier-specific
+    orders, so parity is lenient — and everything must stay finite."""
+    _tier(monkeypatch, tier)
+    rl, dl, lc, *_ = _mixed_tick(cache_type="q8_0")
+    np.testing.assert_allclose(np.asarray(rl[:2]), np.asarray(dl[:2]),
+                               rtol=5e-2, atol=5e-2)
+    np.testing.assert_allclose(np.asarray(rl[2]), np.asarray(lc[0]),
+                               rtol=5e-2, atol=5e-2)
+    assert np.isfinite(np.asarray(rl)).all()
+
+
+@pytest.mark.slow
+def test_mixed_tick_bf16_pool(monkeypatch):
+    _tier(monkeypatch, "xla")
+    rl, dl, lc, *_ = _mixed_tick(
+        dtype=jnp.bfloat16,
+        cfg=dataclasses.replace(TINY, dtype="bfloat16"))
+    np.testing.assert_allclose(np.asarray(rl[:2]), np.asarray(dl[:2]),
+                               rtol=5e-2, atol=5e-2)
+    np.testing.assert_allclose(np.asarray(rl[2]), np.asarray(lc[0]),
+                               rtol=5e-2, atol=5e-2)
+
+
+# ------------------------------------------------------------------- TP
+
+@pytest.fixture(scope="module")
+def mesh4():
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >= 4 devices")
+    from localai_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    return build_mesh(MeshConfig(data=1, model=4), jax.devices()[:4])
+
+
+def _tp_case():
+    """[T=16] flat stream: seq0 = one decode row (kv 9), seq1 = an 8-token
+    chunk (kv 8). KVH=4 so the 4-wide model axis gets one KV head each."""
+    KVH, D, NB = 4, 16, 6
+    k = jax.random.normal(jax.random.PRNGKey(0), (NB, KVH, BLOCK, D),
+                          jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(1), (NB, KVH, BLOCK, D),
+                          jnp.float32)
+    q = jax.random.normal(jax.random.PRNGKey(2), (16, KVH, D), jnp.float32)
+    meta = dict(block_seq=jnp.array([0, 1], jnp.int32),
+                qstart=jnp.array([0, 8], jnp.int32),
+                qlen=jnp.array([1, 8], jnp.int32),
+                kvlen=jnp.array([9, 8], jnp.int32),
+                tables=jnp.array([[1, 2], [3, 4]], jnp.int32))
+    return k, v, q, meta
+
+
+@pytest.mark.tp
+@pytest.mark.slow
+def test_sharded_attention_matches_unsharded(mesh4, monkeypatch):
+    from localai_tpu.ops.pallas import (
+        ragged_attention_xla, ragged_paged_attention_sharded,
+    )
+
+    monkeypatch.setenv("LOCALAI_FORCE_PALLAS", "1")
+    k, v, q, meta = _tp_case()
+    ref = ragged_attention_xla(q, k, v, **meta)
+    got = ragged_paged_attention_sharded(mesh4, q, k, v, **meta)
+    live = [0] + list(range(8, 16))  # dead pad rows are don't-care
+    np.testing.assert_allclose(np.asarray(got)[live], np.asarray(ref)[live],
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.tp
+@pytest.mark.slow
+def test_sharded_scatter_matches_xla(mesh4, monkeypatch):
+    from localai_tpu.ops.pallas import (
+        ragged_scatter_append_sharded, ragged_scatter_xla,
+    )
+
+    monkeypatch.setenv("LOCALAI_FORCE_PALLAS", "1")
+    k, v, q, _ = _tp_case()
+    kn = jax.random.normal(jax.random.PRNGKey(3), (16, 4, 16), jnp.float32)
+    vn = jax.random.normal(jax.random.PRNGKey(4), (16, 4, 16), jnp.float32)
+    pb = jnp.array([1] + [0] * 7 + [3] * 8, jnp.int32)
+    off = jnp.array([9] + list(range(7)) + list(range(8, 16)), jnp.int32)
+    rk, rv = ragged_scatter_xla(k, v, kn, vn, pb, off)
+    gk, gv = ragged_scatter_append_sharded(mesh4, k, v, kn, vn, pb, off)
+    # padding rows (block 0) collide by design; compare the live targets
+    np.testing.assert_allclose(np.asarray(gk[1:]), np.asarray(rk[1:]),
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(gv[1:]), np.asarray(rv[1:]),
+                               atol=1e-6)
+
+
+@pytest.mark.tp
+@pytest.mark.slow
+def test_sharded_q8_ops_match_xla(mesh4, monkeypatch):
+    from localai_tpu.ops.pallas import (
+        ragged_attention_xla_q8, ragged_paged_attention_q8_sharded,
+        ragged_scatter_append_q8_sharded, ragged_scatter_xla_q8,
+    )
+
+    monkeypatch.setenv("LOCALAI_FORCE_PALLAS", "1")
+    k, v, q, meta = _tp_case()
+    kq, vq = init_paged(1, 6, 4, 16, cache_type="q8_0")
+    kq, ks = kq.q[0], kq.s[0]
+    vqq, vs = vq.q[0], vq.s[0]
+    kn = jax.random.normal(jax.random.PRNGKey(3), (16, 4, 16), jnp.float32)
+    vn = jax.random.normal(jax.random.PRNGKey(4), (16, 4, 16), jnp.float32)
+    pb = jnp.array([1] + [0] * 7 + [3] * 8, jnp.int32)
+    off = jnp.array([9] + list(range(7)) + list(range(8, 16)), jnp.int32)
+    ra = ragged_scatter_xla_q8(kq, ks, vqq, vs, kn, vn, pb, off)
+    ga = ragged_scatter_append_q8_sharded(mesh4, kq, ks, vqq, vs, kn, vn,
+                                          pb, off)
+    for r, g in zip(ra, ga):
+        np.testing.assert_allclose(np.asarray(g[1:]), np.asarray(r[1:]),
+                                   atol=1e-6)
+    ref = ragged_attention_xla_q8(q, *ra, **meta)
+    got = ragged_paged_attention_q8_sharded(mesh4, q, *ga, **meta)
+    live = [0] + list(range(8, 16))  # dead pad rows are don't-care
+    np.testing.assert_allclose(np.asarray(got)[live], np.asarray(ref)[live],
+                               rtol=2e-2, atol=2e-2)
+
+
+# ------------------------------------------------------------ engine parity
+
+@pytest.fixture(scope="module")
+def loaded(tmp_path_factory):
+    ckpt = tiny_checkpoint(tmp_path_factory)
+    cfg = load_config(ckpt, dtype="float32")
+    params = load_params(ckpt, cfg)
+    tok = Tokenizer.from_dir(ckpt)
+    return cfg, params, tok
+
+
+def _mixed_reqs(cfg):
+    rng = np.random.default_rng(0)
+    lens = (5, 12, 33, 7, 21, 3)
+    sps = [SamplingParams(temperature=0.0),
+           SamplingParams(temperature=0.8, seed=11),
+           SamplingParams(temperature=0.7, top_p=0.9, seed=3),
+           SamplingParams(temperature=0.0),
+           SamplingParams(temperature=1.0, top_k=5, seed=7),
+           SamplingParams(temperature=0.0)]
+    return [GenRequest(rng.integers(5, cfg.vocab_size, n).tolist(), sp,
+                       max_tokens=10, ignore_eos=True)
+            for n, sp in zip(lens, sps)]
+
+
+def _run_stream(cfg, params, tok, budget):
+    eng = Engine(cfg, params, tok, EngineConfig(
+        max_slots=4, max_context=128, prefill_buckets=(16, 64),
+        prefill_chunk=16, kv_pages=10, prompt_cache=False,
+        ragged_token_budget=budget))
+    reqs = _mixed_reqs(cfg)
+    outs = [eng.submit(r) for r in reqs[:3]]
+    for _ in range(3):
+        eng.step()          # admit the rest mid-decode (mixed ticks)
+    outs += [eng.submit(r) for r in reqs[3:]]
+    for _ in range(500):
+        if not eng.step():
+            break
+    toks = []
+    for _, q in outs:
+        seq = []
+        while not q.empty():
+            o = q.get_nowait()
+            if o.token_id >= 0:
+                seq.append(o.token_id)
+        toks.append(seq)
+    return toks, dict(eng.metrics)
+
+
+def test_engine_ragged_stream_parity(loaded):
+    """Acceptance: identical token streams ragged vs dense across mixed
+    lengths and mixed sampling knobs (greedy, seeded top-p, seeded top-k),
+    with admissions landing mid-decode — and the ragged engine actually
+    ran mixed dispatches."""
+    cfg, params, tok = loaded
+    dense, _ = _run_stream(cfg, params, tok, budget=0)
+    ragged, m = _run_stream(cfg, params, tok, budget=64)
+    assert all(len(s) == 10 for s in dense)
+    assert dense == ragged
+    assert m["ragged_dispatches"] > 0
+    assert m["ragged_tokens_packed"] > m["ragged_dispatches"]
+
+
+def test_admission_packs_kv_in_the_same_tick(loaded):
+    """A chunked admission's first prefill window rides the SAME tick's
+    ragged dispatch (admission is host-only bookkeeping): after one step()
+    the engine has already packed prompt tokens, with no dense prefill
+    dispatch in between."""
+    cfg, params, tok = loaded
+    eng = Engine(cfg, params, tok, EngineConfig(
+        max_slots=4, max_context=128, prefill_buckets=(16, 64),
+        prefill_chunk=16, kv_pages=10, prompt_cache=False,
+        ragged_token_budget=64))
+    prompt = np.random.default_rng(1).integers(
+        5, cfg.vocab_size, 40).tolist()
+    _, q = eng.submit(GenRequest(prompt, SamplingParams(temperature=0.0),
+                                 max_tokens=4, ignore_eos=True))
+    eng.step()
+    assert eng.metrics["ragged_dispatches"] == 1
+    assert eng.metrics["ragged_tokens_packed"] == 16  # first chunk, packed
+    for _ in range(100):
+        if not eng.step():
+            break
+    ids = []
+    while not q.empty():
+        o = q.get_nowait()
+        if o.token_id >= 0:
+            ids.append(o.token_id)
+    # the packed-KV stream must equal the dense engine's
+    ref_eng = Engine(cfg, params, tok, EngineConfig(
+        max_slots=4, max_context=128, prefill_buckets=(16, 64),
+        prefill_chunk=16, kv_pages=10, prompt_cache=False))
+    _, rq = ref_eng.submit(GenRequest(prompt,
+                                      SamplingParams(temperature=0.0),
+                                      max_tokens=4, ignore_eos=True))
+    for _ in range(100):
+        if not ref_eng.step():
+            break
+    ref = []
+    while not rq.empty():
+        o = rq.get_nowait()
+        if o.token_id >= 0:
+            ref.append(o.token_id)
+    assert ids == ref and len(ids) == 4
+
+
+def test_ragged_requires_paged_kv(loaded):
+    cfg, params, tok = loaded
+    with pytest.raises(ValueError, match="paged"):
+        Engine(cfg, params, tok, EngineConfig(
+            max_slots=2, max_context=128, prefill_buckets=(16,),
+            ragged_token_budget=64))
+
+
+# ------------------------------------------------------ structural proofs
+
+def _ragged_jaxpr(monkeypatch, tier, t=64, nseq=8, nb=12):
+    _tier(monkeypatch, tier)
+    cfg = dataclasses.replace(TINY, hidden_size=32, intermediate_size=64,
+                              num_heads=4, num_kv_heads=2, head_dim=8)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    cos, sin = rope_table(cfg.rope, 256)
+    kc, vc = init_paged(cfg.num_layers, nb, cfg.num_kv_heads, cfg.head_dim,
+                        jnp.float32)
+    jaxpr = jax.make_jaxpr(
+        lambda kc, vc, tokens, bs, q0, q1, kl, tb, lr: ragged_forward(
+            params, cfg, tokens, cos, sin, kc, vc, bs, q0, q1, kl, tb, lr)
+    )(kc, vc, jnp.zeros((t,), jnp.int32),
+      jnp.zeros((t // 8,), jnp.int32), jnp.zeros((nseq,), jnp.int32),
+      jnp.zeros((nseq,), jnp.int32), jnp.zeros((nseq,), jnp.int32),
+      jnp.zeros((nseq, 2), jnp.int32), jnp.zeros((nseq,), jnp.int32))
+    pool_elems = nb * cfg.num_kv_heads * BLOCK * cfg.head_dim
+    return jaxpr, pool_elems
+
+
+def _pool_hits(jaxpr, pool_elems):
+    bad = []
+
+    def visit(jx):
+        for eqn in jx.eqns:
+            if eqn.primitive.name in (
+                    "gather", "scatter", "scatter-add", "scatter-mul",
+                    "scatter_apply", "dynamic_update_slice"):
+                for var in list(eqn.invars) + list(eqn.outvars):
+                    aval = getattr(var, "aval", None)
+                    if aval is not None and getattr(aval, "size", 0) \
+                            >= pool_elems:
+                        bad.append((eqn.primitive.name, tuple(aval.shape)))
+            for p in eqn.params.values():
+                for sub in (p if isinstance(p, (list, tuple)) else [p]):
+                    sub = getattr(sub, "jaxpr", sub)
+                    if hasattr(sub, "eqns"):
+                        visit(sub)
+    visit(jaxpr.jaxpr)
+    return bad
+
+
+def test_ragged_jaxpr_no_full_pool_ops(monkeypatch):
+    """Acceptance: on the Pallas tier the ragged forward's jaxpr contains
+    NO gather/scatter over anything pool-sized — KV reads stream through
+    the tables inside the kernel, writes ride the flat-row scatter DMA."""
+    jaxpr, pool_elems = _ragged_jaxpr(monkeypatch, "pallas")
+    hits = _pool_hits(jaxpr, pool_elems)
+    assert not hits, f"full-pool gather/scatter in the ragged program: {hits}"
+
+
+def test_ragged_jaxpr_detector_not_vacuous(monkeypatch):
+    """The same detector DOES fire on the XLA reference tier (per-q-block
+    gather + index scatter over the pool) — the assertion has teeth."""
+    jaxpr, pool_elems = _ragged_jaxpr(monkeypatch, "xla")
+    assert _pool_hits(jaxpr, pool_elems)
+
+
+def _activation_footprint(jaxpr, pool_elems):
+    """Sum of computed (outvar) float-aval sizes, excluding pool-sized
+    buffers that just flow through — the program's activation bill."""
+    total = 0
+
+    def visit(jx):
+        nonlocal total
+        for eqn in jx.eqns:
+            for var in eqn.outvars:
+                aval = getattr(var, "aval", None)
+                if aval is None or not hasattr(aval, "dtype"):
+                    continue
+                if jnp.issubdtype(aval.dtype, jnp.floating) \
+                        and aval.size < pool_elems:
+                    total += aval.size
+            for p in eqn.params.values():
+                for sub in (p if isinstance(p, (list, tuple)) else [p]):
+                    sub = getattr(sub, "jaxpr", sub)
+                    if hasattr(sub, "eqns"):
+                        visit(sub)
+    visit(jaxpr.jaxpr)
+    return total
+
+
+def test_ragged_work_scales_with_tokens_not_slots(monkeypatch):
+    """The no-bucket-padding proof: doubling the SLOT count (same packed
+    budget) leaves the activation footprint nearly unchanged, while
+    doubling the token budget roughly doubles it. A bucketed program pads
+    per sequence, so its footprint scales with slots — this one's scales
+    with the tokens actually packed, which is what makes 256 slots
+    affordable."""
+    base, pe = _ragged_jaxpr(monkeypatch, "xla", t=64, nseq=8)
+    more_slots, _ = _ragged_jaxpr(monkeypatch, "xla", t=64, nseq=32)
+    more_tokens, _ = _ragged_jaxpr(monkeypatch, "xla", t=128, nseq=8)
+    s0 = _activation_footprint(base, pe)
+    s_slots = _activation_footprint(more_slots, pe)
+    s_tokens = _activation_footprint(more_tokens, pe)
+    assert s_slots < 1.3 * s0, (s0, s_slots)
+    assert s_tokens > 1.6 * s0, (s0, s_tokens)
