@@ -694,6 +694,168 @@ def bench_ragged(args, size: str, on_cpu: bool):
     return dense, ragged, equal, pages, budget, context, dtype
 
 
+def _longctx_leg(args, cfg, params, *, max_context, kv_policy="",
+                 kv_cold_pages=0, prompt_tokens, decode_steps,
+                 greedy=False, seed=1):
+    """One single-slot long-context leg: admit a `prompt_tokens` prompt,
+    wait until prefill completes, then time the pure decode window.
+    Returns (tok_s, token_ids, metrics)."""
+    import numpy as np
+
+    from localai_tpu.engine import Engine, EngineConfig, GenRequest
+    from localai_tpu.engine.kvtier import (
+        engine_margin_tokens, parse_policy, resident_blocks,
+    )
+    from localai_tpu.ops.paged import blocks_needed
+    from localai_tpu.ops.sampling import SamplingParams
+
+    chunk = min(512, max_context)
+    ec = EngineConfig(max_slots=1, max_context=max_context,
+                      prefill_buckets=(128, chunk), prefill_chunk=chunk,
+                      kv_pages=1, kv_policy=kv_policy,
+                      kv_cold_pages=kv_cold_pages)
+    pol = parse_policy(kv_policy)
+    if pol.windowed:
+        pages = resident_blocks(pol, engine_margin_tokens(ec)) + 3
+    else:
+        pages = blocks_needed(max_context) + 2
+    ec = EngineConfig(max_slots=1, max_context=max_context,
+                      prefill_buckets=(128, chunk), prefill_chunk=chunk,
+                      kv_pages=pages, kv_policy=kv_policy,
+                      kv_cold_pages=kv_cold_pages)
+    eng = Engine(cfg, params, None, ec)
+    rng = np.random.default_rng(seed)
+
+    def req(n_prompt, n_decode):
+        return GenRequest(
+            prompt_ids=rng.integers(1, cfg.vocab_size, n_prompt).tolist(),
+            params=SamplingParams(temperature=0.0 if greedy else 0.8,
+                                  seed=seed),
+            max_tokens=n_decode, ignore_eos=True)
+
+    # compile admission + decode on a short request so the timed window
+    # below measures steady-state decode, not XLA compiles
+    _, out = eng.submit(req(8, 4))
+    while eng.step():
+        pass
+    while not out.empty():
+        out.get()
+
+    rng = np.random.default_rng(seed)   # same prompt across legs
+    _, out = eng.submit(req(prompt_tokens, decode_steps))
+    while eng._slots[0] is None or not eng._slots[0].prefilled:
+        eng.step()
+    n0 = eng.metrics["tokens_generated"]
+    t0 = time.perf_counter()
+    while eng.step():
+        pass
+    dt = time.perf_counter() - t0
+    toks = eng.metrics["tokens_generated"] - n0
+    ids = []
+    while not out.empty():
+        o = out.get()
+        if o.token_id >= 0:
+            ids.append(o.token_id)
+    return toks / max(dt, 1e-9), ids, dict(eng.metrics)
+
+
+def bench_longctx(args, size: str, on_cpu: bool):
+    """Long-context KV tier A/B (BASELINE #2f, engine/kvtier.py): decode
+    tok/s at ctx long_tokens under sink_window vs ctx-1k under full KV
+    (same geometry, one process), plus the tier's two documented parity
+    regimes — token-exact when sinks+window cover the whole context, and
+    int8-tolerance agreement for quantize_cold (full-precision sinks +
+    window, sub-channel-int8 middle)."""
+    import jax
+
+    from localai_tpu.engine.loader import load_config, load_params
+    from localai_tpu.ops.paged import BLOCK, blocks_needed
+
+    long_tokens = args.longctx_tokens
+    sinks, window = args.kv_sinks, args.kv_window
+    decode = args.decode_steps
+    tmp = tempfile.mkdtemp(prefix="bench-ckpt-")
+    ckpt = write_synthetic_checkpoint(size, os.path.join(tmp, size))
+    # the tier exists to serve contexts past the model's native training
+    # length — raise the synthetic geometry's rope table to match
+    cfgp = os.path.join(ckpt, "config.json")
+    with open(cfgp) as fh:
+        body = json.load(fh)
+    body["max_position_embeddings"] = max(
+        body.get("max_position_embeddings", 0),
+        long_tokens + decode + 2 * BLOCK)
+    with open(cfgp, "w") as fh:
+        json.dump(body, fh)
+    os.environ["LOCALAI_ALLOW_SYNTHETIC"] = "1"
+    dtype = args.dtype or ("int8" if size == "8b" else "bfloat16")
+    if on_cpu:
+        dtype = args.dtype or "float32"
+    cfg = load_config(ckpt, dtype=dtype)
+    params = load_params(ckpt, cfg, dtype=dtype)
+    jax.block_until_ready(params)
+    note("params initialized")
+
+    policy = f"sink_window(sinks={sinks}, window={window})"
+    short_ctx = 1024 + decode + 2 * BLOCK
+    short_tok_s, _, _ = _longctx_leg(
+        args, cfg, params, max_context=short_ctx, prompt_tokens=1024,
+        decode_steps=decode)
+    note(f"ctx-1k full: {short_tok_s:.1f} tok/s")
+    long_ctx = long_tokens + decode + 2 * BLOCK
+    long_tok_s, _, lm = _longctx_leg(
+        args, cfg, params, max_context=long_ctx, kv_policy=policy,
+        prompt_tokens=long_tokens, decode_steps=decode)
+    note(f"ctx-{long_tokens // 1024}k {policy}: {long_tok_s:.1f} tok/s "
+         f"({long_tok_s / max(short_tok_s, 1e-9):.2f}x of ctx-1k), "
+         f"pool peak {lm['kv_blocks_peak']} blocks, "
+         f"{lm['kv_evictions']} evictions")
+
+    # parity probe 1: sinks+window >= context -> nothing ever leaves
+    # retention, token streams must be EXACTLY the full-KV ones
+    probe_ctx = 512 + 2 * BLOCK
+    _, ref_ids, _ = _longctx_leg(
+        args, cfg, params, max_context=probe_ctx, prompt_tokens=384,
+        decode_steps=32, greedy=True)
+    _, tier_ids, _ = _longctx_leg(
+        args, cfg, params, max_context=probe_ctx,
+        kv_policy="sink_window(sinks=128, window=640)", prompt_tokens=384,
+        decode_steps=32, greedy=True)
+    parity_exact = tier_ids == ref_ids
+    note(f"parity (sinks+window >= ctx): "
+         f"{'exact' if parity_exact else 'DIVERGED'}")
+
+    # parity probe 2: quantize_cold with window < prompt — every position
+    # stays readable (middle blocks at int8), so agreement vs full KV is
+    # bounded by int8 quantization error only (the documented tolerance)
+    cold_ctx = 1024 + 2 * BLOCK
+    _, ref2, _ = _longctx_leg(
+        args, cfg, params, max_context=cold_ctx, prompt_tokens=768,
+        decode_steps=32, greedy=True)
+    _, cold_ids, cm = _longctx_leg(
+        args, cfg, params, max_context=cold_ctx,
+        kv_policy="sink_window(sinks=128, window=256, quantize_cold=true)",
+        kv_cold_pages=blocks_needed(cold_ctx) + 2, prompt_tokens=768,
+        decode_steps=32, greedy=True)
+    agree = sum(a == b for a, b in zip(cold_ids, ref2))
+    cold_agreement = agree / max(len(ref2), 1)
+    note(f"parity (quantize_cold int8): {cold_agreement:.2f} agreement, "
+         f"{cm['kv_cold_blocks']} blocks demoted")
+
+    import shutil
+
+    shutil.rmtree(tmp, ignore_errors=True)
+    return {
+        "short_tok_s": short_tok_s, "long_tok_s": long_tok_s,
+        "long_tokens": long_tokens, "policy": policy,
+        "kv_blocks_peak": lm["kv_blocks_peak"],
+        "kv_evictions": lm["kv_evictions"],
+        "parity_exact": parity_exact,
+        "parity_cold_agreement": cold_agreement,
+        "cold_blocks": cm["kv_cold_blocks"],
+        "dtype": dtype,
+    }
+
+
 def bench_embed(args, size: str, on_cpu: bool):
     """BASELINE config #3: /v1/embeddings-path throughput (served gRPC
     Embedding RPC, batch inputs) → embeddings/s."""
@@ -830,7 +992,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="tiny|1b|3b|8b (default: 8b on TPU, tiny on CPU)")
     p.add_argument("--mode", default="serve",
                    choices=["serve", "engine", "embed", "whisper", "paged",
-                            "tp", "ragged"],
+                            "tp", "ragged", "longctx"],
                    help="serve = gRPC backend subprocess (default); engine = "
                         "in-process; paged = dense AND paged in one process "
                         "with a paged_over_dense ratio; tp = single device "
@@ -840,6 +1002,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "through the flat-stream dispatch, three legs "
                         "(dense mixed / ragged mixed / ragged equal) with "
                         "ragged_over_dense + mixed_over_equal ratios; "
+                        "longctx = KV lifecycle tier: ctx-32k decode under "
+                        "sink_window vs ctx-1k full KV with a "
+                        "longctx_over_short ratio, bounded-pool peak, and "
+                        "token-parity probes (BASELINE #2f); "
                         "embed/whisper = BASELINE configs #3/#4")
     p.add_argument("--embed-batch", type=int, default=256)
     p.add_argument("--dtype", default=None,
@@ -860,6 +1026,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="ragged token rows per mixed dispatch (--mode "
                         "ragged; 0 = auto: slots*8 + 128 — every decode "
                         "slot plus one 128-token prefill chunk)")
+    p.add_argument("--longctx-tokens", type=int, default=32768,
+                   help="long-leg prompt length for --mode longctx")
+    p.add_argument("--kv-window", type=int, default=1024,
+                   help="sink_window retention window for --mode longctx")
+    p.add_argument("--kv-sinks", type=int, default=256,
+                   help="attention-sink tokens for --mode longctx")
     p.add_argument("--kv-pages", type=int, default=0,
                    help="paged KV pool size in 128-token blocks "
                         "(0 = dense per-slot cache); lets slot count "
@@ -1030,6 +1202,35 @@ def main(argv=None):
             "device": device_kind,
             "params": n_params,
             **stats,
+        }
+        if on_cpu and not args.cpu:
+            result["probe_error"] = probe_error[:500]
+        return emit_result(result, args)
+    if args.mode == "longctx":
+        import jax
+
+        if on_cpu:
+            jax.config.update("jax_platforms", "cpu")
+        note("initializing device client...")
+        dev = jax.devices()[0]
+        device_kind = getattr(dev, "device_kind", dev.platform)
+        r = bench_longctx(args, size, on_cpu)
+        ratio = r["long_tok_s"] / max(r["short_tok_s"], 1e-9)
+        result = {
+            "metric": f"longctx decode tok/s (llama-{size} {r['dtype']}, "
+                      f"ctx {r['long_tokens']} {r['policy']} vs ctx 1024 "
+                      f"full KV, 1 slot) [BASELINE #2f]",
+            "value": round(r["long_tok_s"], 2),
+            "unit": "tok/s",
+            "vs_baseline": None,
+            "short_tok_s": round(r["short_tok_s"], 2),
+            "longctx_over_short": round(ratio, 4),
+            "kv_blocks_peak": r["kv_blocks_peak"],
+            "kv_evictions": r["kv_evictions"],
+            "parity_exact": r["parity_exact"],
+            "parity_cold_agreement": round(r["parity_cold_agreement"], 4),
+            "cold_blocks": r["cold_blocks"],
+            "device": device_kind,
         }
         if on_cpu and not args.cpu:
             result["probe_error"] = probe_error[:500]

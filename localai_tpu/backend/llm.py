@@ -197,6 +197,15 @@ class LLMServicer(BackendServicer):
         # one storage kind for both K and V (quantize when either side asks;
         # the reference allows split k/v types — grpc-server.cpp:236-251)
         cache_type = kv_kind
+        # KV lifecycle tier rides the ModelOptions.options JSON blob (no
+        # dedicated proto field — same lane as the hfapi endpoint override)
+        kv_policy, kv_cold_pages = "", 0
+        if request.options:
+            import json
+
+            opts = json.loads(request.options)  # typos fail the load loudly
+            kv_policy = str(opts.get("kv_policy", ""))
+            kv_cold_pages = int(opts.get("kv_cold_pages", 0))
         self.engine = Engine(cfg, params, tok, EngineConfig(
             max_slots=request.parallel or 4,
             max_context=context_size,
@@ -206,6 +215,8 @@ class LLMServicer(BackendServicer):
             gamma=request.n_draft or 4,
             cache_type=cache_type,
             kv_pages=request.kv_pages,
+            kv_policy=kv_policy,
+            kv_cold_pages=kv_cold_pages,
         ), draft=draft)
         if request.embeddings:
             from localai_tpu.engine.embedder import CrossScorer

@@ -62,6 +62,15 @@ def _add_run(sub):
                    help="graceful-shutdown hard deadline: SIGTERM and "
                         "/backend/shutdown let in-flight requests finish "
                         "this long while new work gets 503 (default 30)")
+    # KV lifecycle tier (engine/kvtier.py) — app-wide default; a per-model
+    # YAML kv_policy wins
+    p.add_argument("--kv-window", type=int, default=None,
+                   help="retain only the last N tokens of KV per request "
+                        "(attention-sink + sliding-window tier for 32k-128k "
+                        "serving); 0/unset = full KV")
+    p.add_argument("--kv-sinks", type=int, default=None,
+                   help="keep the first N tokens (attention sinks) resident "
+                        "alongside --kv-window")
     p.add_argument("--trace", action="store_true",
                    help="record request/engine spans (LOCALAI_TRACE=1); "
                         "export via /debug/trace or `util trace`")

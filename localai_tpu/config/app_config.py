@@ -41,6 +41,11 @@ class AppConfig:
     spawn_retries: int = 2           # fresh-port respawns when the child
                                      # dies before health (port TOCTOU)
     spawn_timeout: float = 120.0     # health budget per spawn attempt (s)
+    kv_window: int = 0               # app-default KV retention window in
+                                     # tokens (engine/kvtier.py); 0 = full
+                                     # KV. A per-model YAML kv_policy wins.
+    kv_sinks: int = 0                # attention-sink tokens kept alongside
+                                     # the window (only with kv_window > 0)
     preload_models: list[str] = dataclasses.field(default_factory=list)
     log_level: str = "info"
     machine_tag: str = ""
@@ -60,7 +65,8 @@ class AppConfig:
                             ("breaker_threshold", int),
                             ("breaker_cooldown", float),
                             ("queue_depth", int), ("drain_timeout", float),
-                            ("spawn_retries", int), ("spawn_timeout", float)]:
+                            ("spawn_retries", int), ("spawn_timeout", float),
+                            ("kv_window", int), ("kv_sinks", int)]:
             v = env(field.upper(), cast)
             if v is not None:
                 setattr(cfg, field, v)

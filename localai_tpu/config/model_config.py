@@ -114,6 +114,11 @@ class ModelConfig:
     cache_type_v: str = ""           # (reference cache_type_k/v YAML keys)
     kv_pages: int = 0                # paged KV pool size in 128-token blocks
                                      # (0 = dense per-slot cache)
+    kv_policy: str = ""              # KV lifecycle tier (engine/kvtier.py):
+                                     # ""|"full"|"sink_window(sinks=N,
+                                     # window=W[, quantize_cold=true])"
+    kv_cold_pages: int = 0           # int8 cold pool size in 128-token
+                                     # blocks (quantize_cold policies)
     mcp: dict = dataclasses.field(default_factory=dict)
                                      # MCP servers {servers: [...], stdio:
                                      # [...]} (reference config.MCP block)

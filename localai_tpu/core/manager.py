@@ -17,6 +17,7 @@ reaps and breaker rejections into typed errors the HTTP layer maps to
 from __future__ import annotations
 
 import collections
+import json
 import os
 import socket
 import subprocess
@@ -216,7 +217,21 @@ class ModelManager:
     def _load_rpc(self, handle: BackendHandle):
         cfg = self.app
         m = handle.config
+        # fields without a proto slot ride the ModelOptions.options JSON
+        # blob (the hfapi backend's endpoint override uses the same lane)
+        opts = {}
+        kv_policy = m.kv_policy
+        if not kv_policy and cfg.kv_window:
+            # app-wide --kv-window default for models without their own
+            # kv_policy (per-model YAML wins)
+            kv_policy = (f"sink_window(sinks={cfg.kv_sinks}, "
+                         f"window={cfg.kv_window})")
+        if kv_policy:
+            opts["kv_policy"] = kv_policy
+        if m.kv_cold_pages:
+            opts["kv_cold_pages"] = m.kv_cold_pages
         r = handle.client.load_model(
+            options=json.dumps(opts) if opts else "",
             model=m.model_dir(cfg.models_path),
             context_size=m.context_size or cfg.context_size,
             parallel=m.parallel or cfg.parallel_requests,

@@ -122,6 +122,23 @@ class EngineConfig:
                                   # paths are untouched). Rounded up to a
                                   # QBLK (8-row) multiple; grammar slots and
                                   # multimodal windows keep the dense paths.
+    kv_policy: str = "full"       # KV lifecycle tier (engine/kvtier.py):
+                                  # "full" keeps every block hot (identical
+                                  # to the untiered engine), "sink_window(
+                                  # sinks=N, window=W[, quantize_cold=true])"
+                                  # switches the paged table to COMPACT ring
+                                  # geometry — O(sinks+window) resident
+                                  # blocks per slot for ANY context length.
+                                  # Requires kv_pages; per-request policies
+                                  # (GenRequest.kv_policy) may only shrink
+                                  # the engine geometry.
+    kv_cold_pages: int = 0        # quantize_cold: physical 128-token blocks
+                                  # in the int8 cold pool (incl. reserved
+                                  # index 0 = "not demoted"). Blocks whose
+                                  # tokens exit the window are copied here
+                                  # with sub-channel per-token scales instead
+                                  # of being dropped; a full cold pool falls
+                                  # back to eviction (kv_evictions metric).
     max_restarts: int = 2         # fatal step() errors survived per engine
                                   # lifetime: in-flight streams fail, device
                                   # state is rebuilt, new requests serve
@@ -155,6 +172,12 @@ class GenRequest:
                                   # via the HTTP middleware); the engine
                                   # evicts the slot with finish "timeout"
                                   # instead of decoding past it. 0 = none.
+    kv_policy: str = ""           # per-request KV retention policy ("" =
+                                  # inherit the engine's). "full" or
+                                  # "sink_window(sinks=N, window=W)"; a
+                                  # windowed request needs a windowed engine
+                                  # and may only shrink its geometry
+                                  # (engine/kvtier.resolve_policy)
     # multimodal (models/llava.py): projected image features [K, H] f32 and
     # the prompt positions they occupy (the expanded image-token slots) —
     # injected into prefill instead of token embeddings
@@ -313,6 +336,60 @@ class Engine:
 
             rows = max(self.ec.ragged_token_budget, 2 * QBLK)
             self._ragged_rows = -(-rows // QBLK) * QBLK
+        # KV lifecycle tier (engine/kvtier.py): a windowed engine policy
+        # switches the paged table to COMPACT geometry — the per-slot table
+        # row holds only sink_blocks identity columns plus a reused ring, so
+        # decode gathers O(sinks + window) rows however long the sequence
+        # runs. kv_policy="full" (the default) keeps kvt=None on every
+        # dispatch path — byte-identical programs to the untiered engine.
+        from localai_tpu.engine import kvtier
+
+        self._kv_policy = kvtier.parse_policy(self.ec.kv_policy)
+        self._tiered = self._kv_policy.windowed
+        self._cold = self._tiered and self._kv_policy.quantize_cold
+        if self._tiered:
+            if not self._paged:
+                raise ValueError(
+                    "kv_policy sink_window requires paged KV (set kv_pages)")
+            if self._draft is not None:
+                raise ValueError(
+                    "kv_policy sink_window is incompatible with a draft "
+                    "model (the dense draft cache has no ring geometry)")
+            if self.ec.replicator is not None:
+                raise ValueError(
+                    "kv_policy sink_window does not support multi-host "
+                    "replication (per-slot ring geometry is host state)")
+            if self._ragged and self._cold:
+                raise ValueError(
+                    "quantize_cold is incompatible with ragged continuous "
+                    "batching (the flat-stream program has no cold-tier "
+                    "lane); drop quantize_cold or ragged_token_budget")
+            self._kv_margin = kvtier.engine_margin_tokens(self.ec)
+            self._kv_ring = kvtier.ring_blocks(self._kv_policy.window,
+                                               self._kv_margin)
+            self._kv_resident = kvtier.resident_blocks(self._kv_policy,
+                                                       self._kv_margin)
+            if self._kv_resident > self.ec.kv_pages - 1:
+                raise ValueError(
+                    f"kv_policy {self._kv_policy.describe()} needs "
+                    f"{self._kv_resident} resident blocks per slot but the "
+                    f"pool has {self.ec.kv_pages - 1}; raise kv_pages or "
+                    f"shrink sinks/window")
+            if self._cold:
+                if self.ec.kv_cold_pages < 2:
+                    raise ValueError(
+                        "quantize_cold needs kv_cold_pages >= 2 (cold "
+                        "block 0 is the not-demoted sentinel)")
+                from localai_tpu.ops.kvcache import is_quant_kind
+
+                if is_quant_kind(self.ec.cache_type):
+                    raise ValueError(
+                        "quantize_cold requires a dense hot cache "
+                        "(cache_type=''): the cold tier is already int8")
+        elif self.ec.kv_cold_pages:
+            raise ValueError(
+                "kv_cold_pages needs kv_policy sink_window(..., "
+                "quantize_cold=true)")
         if self._draft is not None and self._draft[0].vocab_size != V:
             raise ValueError("draft vocab differs from target")
         self._kv_dtype = dtype
@@ -386,6 +463,15 @@ class Engine:
             # runs (bench.py --mode ragged reports it)
             self.metrics["ragged_dispatches"] = 0
             self.metrics["ragged_tokens_packed"] = 0
+        if self._tiered:
+            # KV lifecycle telemetry: cold demotions, evictions (window-
+            # exited blocks dropped — ring overwrite, or a full cold pool),
+            # prefix-cache blocks re-prefilled because ring columns can't be
+            # borrowed, admission-time full→window demotions, and pool
+            # occupancy (peak proves the O(sinks+window) residency bound)
+            self.metrics.update(
+                kv_cold_blocks=0, kv_evictions=0, kv_recomputes=0,
+                kv_policy_demotions=0, kv_blocks_in_use=0, kv_blocks_peak=0)
 
         # telemetry (localai_tpu/telemetry): both gates resolve to None/False
         # here so the per-dispatch cost of a disabled build is one attribute
@@ -416,7 +502,11 @@ class Engine:
         if self._paged:
             from localai_tpu.ops.paged import BLOCK
 
-            self._maxb = -(-T // BLOCK)
+            # tiered engines run the COMPACT table: resident columns per
+            # slot (sinks + ring), not ceil(max_context/128) — the whole
+            # point of the lifecycle tier (decode gathers O(resident) rows)
+            self._maxb = (self._kv_resident if self._tiered
+                          else -(-T // BLOCK))
             self._table = np.zeros((B, self._maxb), np.int32)
             self._kv_free: list[int] = list(range(1, self.ec.kv_pages))
             self._slot_blocks: list[list[int]] = [[] for _ in range(B)]
@@ -432,6 +522,27 @@ class Engine:
             self._block_ref[0] = 1          # trash block: pinned forever
             self._hash_index: dict[bytes, int] = {}
             self._block_hash_of: dict[int, bytes] = {}
+        if self._tiered:
+            from localai_tpu.ops.paged import BLOCK
+
+            # per-slot ring geometry, shipped with every dispatch (_kvt).
+            # Full-policy sentinels: sb = table width makes the ring map the
+            # identity and every column resident; window/sinks sentinels at
+            # max_context keep the retention mask all-true for any length.
+            self._kv_sb = np.full((B,), self._maxb, np.int32)
+            self._kv_rw = np.ones((B,), np.int32)
+            self._kv_sinks = np.full((B,), T, np.int32)
+            self._kv_window = np.full((B,), T, np.int32)
+            self._slot_policy: list = [None] * B
+            # next raw (virtual) block index eligible for demotion/eviction
+            # per slot — advanced by _kv_tick as tokens exit the window
+            self._demote_next = np.zeros((B,), np.int64)
+            if self._cold:
+                self._cold_maxb = -(-T // BLOCK)
+                self._cold_table = np.zeros((B, self._cold_maxb), np.int32)
+                self._cold_free: list[int] = list(
+                    range(1, self.ec.kv_cold_pages))
+                self._slot_cold: list[list[int]] = [[] for _ in range(B)]
         self._deferred: tuple | None = None   # admission waiting on blocks
         self._admitting: tuple | None = None  # admission mid-device-call
         self._blocks_freed = False
@@ -448,6 +559,14 @@ class Engine:
                 self._kc, self._vc = init_paged(
                     cfg.num_layers, self.ec.kv_pages, cfg.num_kv_heads,
                     cfg.head_dim, dtype, cache_type=self.ec.cache_type)
+                if self._cold:
+                    # parallel int8 cold pool (sub-channel per-token scales,
+                    # Transformer-Lite): window-exited blocks are copied
+                    # here by _dev_demote and read back through cold_tab
+                    self._ck, self._cv = init_paged(
+                        cfg.num_layers, self.ec.kv_cold_pages,
+                        cfg.num_kv_heads, cfg.head_dim, dtype,
+                        cache_type="int8")
             else:
                 self._kc, self._vc = init_kv_cache(
                     cfg, B, T, dtype, cache_type=self.ec.cache_type)
@@ -536,7 +655,7 @@ class Engine:
 
         def _admit_many(params, cos, sin, kc, vc, sampler, last_logits,
                         lengths, tokens, lens, slots, rows, counts_rows,
-                        table=None, inject=None):
+                        table=None, inject=None, kvt=None):
             """Admission burst: prefill K same-bucket requests in ONE pass.
 
             The single-request _admit streams the full weight set per call —
@@ -547,7 +666,7 @@ class Engine:
             a time, grpc-server.cpp update_slots)."""
             logits, kc, vc = prefill(
                 params, cfg, tokens, lens, cos, sin, kc, vc, slots, table,
-                inject
+                inject, kvt
             )
             last_logits = last_logits.at[slots].set(logits)
             lengths = lengths.at[slots].set(lens)
@@ -555,19 +674,20 @@ class Engine:
             return kc, vc, sampler, last_logits, lengths
 
         def _extend_mid(params, cos, sin, kc, vc, tokens, start, slot,
-                        table=None, inject=None):
+                        table=None, inject=None, kvt=None):
             """One non-final prefill chunk: KV writes only. Mid chunks are
             always full (the final chunk takes _extend_final), so every
             position sits inside the slot's allocation → full_window keeps
             the paged scatter on the asserted-unique in-place path."""
             _, kc, vc = extend(params, cfg, tokens, start[None], cos, sin,
                                kc, vc, slot_map=slot[None], with_logits=False,
-                               table=table, inject=inject, full_window=True)
+                               table=table, inject=inject, full_window=True,
+                               kvt=kvt)
             return kc, vc
 
         def _extend_final(params, cos, sin, kc, vc, sampler, last_logits,
                           lengths, tokens, start, nvalid, slot, row,
-                          counts_row, table=None, inject=None):
+                          counts_row, table=None, inject=None, kvt=None):
             """Final prefill chunk: KV writes + last-token logits + sampler
             row install (deferred to here so the request's RNG stream is
             independent of how many engine ticks the prefill spanned)."""
@@ -575,19 +695,20 @@ class Engine:
                 params, cfg, tokens, start[None], cos, sin, kc, vc,
                 slot_map=slot[None],
                 last_pos=jnp.maximum(nvalid - 1, 0)[None], table=table,
-                inject=inject)
+                inject=inject, kvt=kvt)
             last_logits = last_logits.at[slot].set(logits[0])
             lengths = lengths.at[slot].set(start + nvalid)
             sampler = _install_row(sampler, slot, row, counts_row)
             return kc, vc, sampler, last_logits, lengths
 
         def _decode(params, cos, sin, kc, vc, sampler, last_logits, lengths,
-                    active, mask_bits, fast_width=None, table=None):
+                    active, mask_bits, fast_width=None, table=None, kvt=None):
             """sample(prev logits) → decode → next logits, for all slots."""
             tokens, keys, logprobs = sample(last_logits, sampler, mask_bits,
                                             topk_width=fast_width)
             logits, kc, vc = decode_step(
-                params, cfg, tokens, lengths, cos, sin, kc, vc, active, table
+                params, cfg, tokens, lengths, cos, sin, kc, vc, active, table,
+                kvt
             )
             act = active.astype(jnp.int32)
             counts = sampler.token_counts.at[
@@ -708,8 +829,8 @@ class Engine:
             static_argnames=("fast_width",))
 
         def _decode_block(params, cos, sin, kc, vc, sampler, last_logits,
-                          lengths, active, mask_bits=None, table=None, *,
-                          steps: int, fast_width=None):
+                          lengths, active, mask_bits=None, table=None,
+                          kvt=None, *, steps: int, fast_width=None):
             """`steps` fused sample→decode iterations in ONE device program.
 
             One dispatch + one result fetch per `steps` tokens: on a remote
@@ -724,7 +845,8 @@ class Engine:
                 kc, vc, sampler, last_logits, lengths = carry
                 tokens, logprobs, kc, vc, sampler, last_logits, lengths = (
                     _decode(params, cos, sin, kc, vc, sampler, last_logits,
-                            lengths, active, mask_bits, fast_width, table))
+                            lengths, active, mask_bits, fast_width, table,
+                            kvt))
                 return (kc, vc, sampler, last_logits, lengths), (tokens,
                                                                  logprobs)
             carry = (kc, vc, sampler, last_logits, lengths)
@@ -787,7 +909,7 @@ class Engine:
             def _ragged_step(params, cos, sin, kc, vc, sampler, last_logits,
                              lengths, tokens_flat, decode_slot, is_decode,
                              set_len, logit_set, logit_rows, block_seq,
-                             qstart, qlen, kvlen, table):
+                             qstart, qlen, kvlen, table, kvt=None):
                 sampled, keys, logprobs = sample(last_logits, sampler, None,
                                                  topk_width=None)
                 toks = jnp.where(decode_slot >= 0,
@@ -795,7 +917,7 @@ class Engine:
                                  tokens_flat)
                 logits, kc, vc = ragged_forward(
                     params, cfg, toks, cos, sin, kc, vc, block_seq, qstart,
-                    qlen, kvlen, table, logit_rows)
+                    qlen, kvlen, table, logit_rows, kvt)
                 act = is_decode.astype(jnp.int32)
                 counts = sampler.token_counts.at[
                     jnp.arange(sampled.shape[0]), sampled].add(act)
@@ -812,6 +934,26 @@ class Engine:
 
             self._ragged_fn = jax.jit(_ragged_step,
                                       donate_argnums=(3, 4, 5, 6, 7))
+
+        # cold demotion: copy ONE hot physical block into a cold-pool index
+        # with sub-channel (per-token over head_dim) int8 quantization.
+        # pb/ci are traced scalars → one compiled program however many
+        # blocks ever demote (the compile-count tripwire stays green).
+        self._demote_fn = None
+        if self._cold:
+            from localai_tpu.ops.kvcache import QuantKV, quantize_tokens
+
+            def _demote(kc, vc, ck, cv, pb, ci):
+                def one(hot, cold):
+                    blk = hot[:, pb]                      # [L, KVH, BS, D]
+                    q, scale = quantize_tokens(blk)       # scale [L,KVH,BS]
+                    return QuantKV(
+                        cold.q.at[:, ci].set(q),
+                        cold.s.at[:, ci].set(
+                            scale[:, :, None, :].astype(cold.s.dtype)))
+                return one(kc, ck), one(vc, cv)
+
+            self._demote_fn = jax.jit(_demote, donate_argnums=(2, 3))
 
     # ------------------------------------------------------ device dispatch
     # Every device call goes through one of these. On a multi-host mesh the
@@ -832,6 +974,32 @@ class Engine:
         Tiny ([B, MAXB] i32) — shipping it per call keeps the host allocator
         the single source of truth with no donation bookkeeping."""
         return jnp.asarray(self._table) if self._paged else None
+
+    def _kvt(self):
+        """Per-slot KV-tier geometry for this dispatch (None on untiered
+        engines — every jitted program then traces WITHOUT the tier branch,
+        byte-identical to the pre-tier engine). Like _tab(), the tiny [B]
+        arrays ship per call as runtime data: any mix of full and windowed
+        slots (and any demotion state) reuses one compiled program."""
+        if not self._tiered:
+            return None
+        d = {"sb": jnp.asarray(self._kv_sb), "rw": jnp.asarray(self._kv_rw),
+             "sinks": jnp.asarray(self._kv_sinks),
+             "window": jnp.asarray(self._kv_window)}
+        if self._cold:
+            d["cold_k"], d["cold_v"] = self._ck, self._cv
+            d["cold_tab"] = jnp.asarray(self._cold_table)
+        return d
+
+    def _note_pool(self):
+        """Refresh the pool-occupancy gauges (tiered engines only — the
+        peak is the bench's O(sinks+window) residency proof)."""
+        if not self._tiered:
+            return
+        used = self.ec.kv_pages - 1 - len(self._kv_free)
+        self.metrics["kv_blocks_in_use"] = used
+        if used > self.metrics["kv_blocks_peak"]:
+            self.metrics["kv_blocks_peak"] = used
 
     def _decode_guard(self):
         """Transfer-guard context for the decode dispatch (nullcontext unless
@@ -887,7 +1055,7 @@ class Engine:
                 jnp.asarray(ids), jnp.asarray(lens), jnp.asarray(slots),
                 {k: jnp.asarray(v) for k, v in rows.items()},
                 None if counts_rows is None else jnp.asarray(counts_rows),
-                self._tab(), self._inj(inject))
+                self._tab(), self._inj(inject), self._kvt())
         self._obs("admit", t0, tokens=int(np.sum(lens)),
                   fence=self._lengths, requests=len(slots))
 
@@ -923,7 +1091,7 @@ class Engine:
             self._kc, self._vc = self._extend_mid_fn(
                 self.params, self._cos, self._sin, self._kc, self._vc,
                 jnp.asarray(buf), jnp.int32(pos), jnp.int32(idx), self._tab(),
-                self._inj(inject))
+                self._inj(inject), self._kvt())
         self._obs("prefill", t0, tokens=int(buf.shape[1]), fence=self._kc,
                   slot=int(idx), final=False)
 
@@ -942,7 +1110,7 @@ class Engine:
                 jnp.int32(nvalid), jnp.int32(idx),
                 {k: jnp.asarray(v) for k, v in row.items()},
                 None if counts_row is None else jnp.asarray(counts_row),
-                self._tab(), self._inj(inject))
+                self._tab(), self._inj(inject), self._kvt())
         self._obs("prefill", t0, tokens=int(nvalid), fence=self._lengths,
                   slot=int(idx), final=True)
 
@@ -960,15 +1128,17 @@ class Engine:
             if mask_host is not None:
                 (tokens, logprobs, self._kc, self._vc, self._sampler,
                  self._last_logits, self._lengths) = self._decode_fn(
-                    *args, jnp.asarray(mask_host), table=self._tab())
+                    *args, jnp.asarray(mask_host), table=self._tab(),
+                    kvt=self._kvt())
             elif fast_width:
                 (tokens, logprobs, self._kc, self._vc, self._sampler,
                  self._last_logits, self._lengths) = self._decode_fast_fn(
-                    *args, table=self._tab(), fast_width=fast_width)
+                    *args, table=self._tab(), kvt=self._kvt(),
+                    fast_width=fast_width)
             else:
                 (tokens, logprobs, self._kc, self._vc, self._sampler,
                  self._last_logits, self._lengths) = self._decode_nomask_fn(
-                    *args, table=self._tab())
+                    *args, table=self._tab(), kvt=self._kvt())
         self._obs("decode", t0, tokens=int(np.sum(active)), fence=tokens,
                   fast_width=fast_width or 0,
                   grammar=mask_host is not None)
@@ -990,11 +1160,11 @@ class Engine:
                 (tokens, logprobs, self._kc, self._vc, self._sampler,
                  self._last_logits, self._lengths) = self._decode_block_mask_fn(
                     *args, jnp.asarray(mask_host), table=self._tab(),
-                    steps=steps, fast_width=None)
+                    kvt=self._kvt(), steps=steps, fast_width=None)
             else:
                 (tokens, logprobs, self._kc, self._vc, self._sampler,
                  self._last_logits, self._lengths) = self._decode_block_fn(
-                    *args, table=self._tab(), steps=steps,
+                    *args, table=self._tab(), kvt=self._kvt(), steps=steps,
                     fast_width=fast_width)
         self._obs("decode_block", t0, tokens=steps * int(np.sum(active)),
                   fence=tokens, steps=steps, fast_width=fast_width or 0,
@@ -1020,7 +1190,7 @@ class Engine:
                 self._sampler, self._last_logits, self._lengths,
                 jnp.asarray(active), jnp.asarray(remaining),
                 jnp.asarray(check_eos), self._eos_dev, self._tab(),
-                fast_width=fast_width)
+                fast_width=fast_width, kvt=self._kvt())
         # tokens here is the RESERVED upper bound (actual count rides the
         # fetch); the consume-side "sample" stage records the exact number
         self._obs("decode_loop", t0,
@@ -1057,9 +1227,21 @@ class Engine:
                 jnp.asarray(pack["logit_rows"]),
                 jnp.asarray(pack["block_seq"]),
                 jnp.asarray(pack["qstart"]), jnp.asarray(pack["qlen"]),
-                jnp.asarray(pack["kvlen"]), self._tab())
+                jnp.asarray(pack["kvlen"]), self._tab(), self._kvt())
         self._obs("ragged", t0, tokens=int(pack["packed"]), fence=tokens)
         return _AsyncFetch((tokens, logprobs))
+
+    def _dev_demote(self, pb: int, ci: int):
+        """Copy hot physical block `pb` into cold-pool index `ci` (int8,
+        sub-channel scales). Enqueued AFTER any in-flight decode dispatch on
+        the same stream, so the copy reads the block's final hot content."""
+        t0 = time.perf_counter()
+        self._bcast("demote", pb=pb, ci=ci)
+        with activate_mesh(self.mesh):
+            self._ck, self._cv = self._demote_fn(
+                self._kc, self._vc, self._ck, self._cv,
+                jnp.int32(pb), jnp.int32(ci))
+        self._obs("demote", t0, tokens=128, block=int(pb))
 
     def _dev_install(self, idx, row, counts_row):
         """Sampler-row install for a ragged final prefill chunk (the dense
@@ -1188,6 +1370,8 @@ class Engine:
             self._dev_ragged(kw)
         elif op == "install":
             self._dev_install(kw["idx"], kw["row"], kw["counts_row"])
+        elif op == "demote":
+            self._dev_demote(kw["pb"], kw["ci"])
         elif op == "shift":
             self._dev_shift(kw["idx"])
         elif op == "draft_ingest":
@@ -1247,9 +1431,21 @@ class Engine:
                 "context_shift with paged KV needs max_context spanning "
                 "more than keep+discard blocks (128-token granularity); "
                 "raise max_context or use a dense cache")
+        if req.context_shift and self._tiered:
+            raise ValueError(
+                "context_shift is not supported under a sink_window "
+                "kv_policy (the ring geometry already bounds residency; "
+                "long sequences decode in place up to max_context)")
+        if req.kv_policy:
+            # reject malformed/oversized policies NOW (gRPC
+            # INVALID_ARGUMENT) instead of failing in-band at admission
+            from localai_tpu.engine import kvtier
+
+            kvtier.resolve_policy(req.kv_policy, self._kv_policy)
         if self._paged and self._blocks_for(req) > self.ec.kv_pages - 1:
             raise ValueError(
-                f"request needs {self._blocks_for(req)} KV blocks "
+                f"request needs {self._blocks_for(req)} KV blocks under "
+                f"kv_policy {self._req_policy(req).describe()} "
                 f"(prompt {len(req.prompt_ids)} + max_tokens "
                 f"{req.max_tokens}) but the pool has {self.ec.kv_pages - 1}; "
                 f"raise kv_pages or lower max_tokens")
@@ -1322,6 +1518,7 @@ class Engine:
             n = len(req.prompt_ids)
             chunked = n > self._small_max
             bucket = None if chunked else self._bucket(n)
+            pol = self._req_policy(req) if self._tiered else None
         except Exception:
             self._finish_rid(rid)
             out.put(StepOutput(
@@ -1338,6 +1535,21 @@ class Engine:
             # device dispatch (multimodal keeps the dense path: feature
             # injection is outside the flat-stream program)
             chunked, bucket = True, None
+        if self._tiered and not pol.windowed:
+            # admission-time policy demotion: a full-policy request that
+            # cannot fit the compact table (its identity mapping would write
+            # past the resident columns), or that lands while the free pool
+            # runs low (windowed slots return ALL their blocks at release
+            # instead of retaining a warm prefix), rides the engine's window
+            # instead of being rejected
+            from localai_tpu.ops.paged import blocks_needed
+
+            margin = 2 * self.ec.decode_block + 1
+            base = blocks_needed(min(n + max(req.max_tokens, 0) + margin,
+                                     self.ec.max_context))
+            if base > self._maxb or base > len(self._kv_free):
+                pol = self._kv_policy
+                self.metrics["kv_policy_demotions"] += 1
         # multimodal: id-level prefix reuse would match the repeated image
         # token while the injected features differ — no slot or disk reuse
         slot, lcp = self._pick_slot([] if mm else req.prompt_ids)
@@ -1358,6 +1570,24 @@ class Engine:
                 else:
                     self._unref_blocks(shared)
                     shared = None
+            if pol is not None and pol.windowed and lcp:
+                # a windowed slot may borrow/retain prefix pages ONLY for
+                # whole sink blocks: everything past the sinks lives in
+                # ring columns whose position mapping is per-tenant, so
+                # those cached blocks are re-prefilled (block-granular
+                # recompute — the prefix-cache-shared case)
+                from localai_tpu.ops.paged import BLOCK
+
+                keep = min(lcp // BLOCK, self._kv_policy.sink_blocks)
+                self.metrics["kv_recomputes"] += max(
+                    0, lcp // BLOCK - keep)
+                if shared is not None:
+                    if keep < len(shared):
+                        self._unref_blocks(shared[keep:])
+                        shared = shared[:keep]
+                    if not shared:
+                        shared = None
+                lcp = keep * BLOCK
             eff = self._alloc_slot(slot, req, shared=shared, lcp=lcp)
             if eff is None:
                 # pool exhausted even after reclaim: defer (FIFO) until
@@ -1366,6 +1596,30 @@ class Engine:
                 self._deferred = (rid, req, out)
                 return None
             lcp = eff
+            if self._tiered:
+                # per-slot tier geometry: the RESIDENCY (sb/rw) always uses
+                # the ENGINE window (the ring was sized for it); the request
+                # policy narrows only the attention masks (sinks/window
+                # token counts), so shrunken per-request windows share the
+                # same table layout and compiled program
+                if pol.windowed:
+                    self._kv_sb[slot] = self._kv_policy.sink_blocks
+                    self._kv_rw[slot] = self._kv_ring
+                    self._kv_sinks[slot] = pol.sinks
+                    self._kv_window[slot] = pol.window
+                else:
+                    self._kv_sb[slot] = self._maxb
+                    self._kv_rw[slot] = 1
+                    self._kv_sinks[slot] = self.ec.max_context
+                    self._kv_window[slot] = self.ec.max_context
+                self._slot_policy[slot] = pol
+                self._demote_next[slot] = self._kv_policy.sink_blocks
+                if self._cold:
+                    for ci in self._slot_cold[slot]:
+                        self._cold_free.append(ci)
+                    self._slot_cold[slot] = []
+                    self._cold_table[slot, :] = 0
+                self._note_pool()
         self._slot_kv_tokens[slot] = []
         disk_prefix = 0
         if not lcp and req.prompt_cache_path and not mm:
@@ -2040,6 +2294,48 @@ class Engine:
         self._obs("sample", t0, tokens=emitted, steps=1, rollbacks=0)
         self._dispatch_gauges()
 
+    def _kv_tick(self):
+        """Advance the hot→cold→evicted lifecycle for windowed slots.
+
+        A raw block is eligible the moment its LAST token exits the window
+        of the oldest position any in-flight or future query can hold (the
+        host length only LAGS the device, so eligibility here is
+        conservative). quantize_cold copies the block into the int8 cold
+        pool — the dispatch is enqueued behind any in-flight decode on the
+        same stream, and the ring's +2 slack blocks (kvtier.ring_blocks)
+        guarantee the copy lands before the ring wraps over the block. A
+        full cold pool, or a drop-policy slot, counts the block evicted
+        (the ring overwrite IS the eviction — SnapStream semantics)."""
+        if not self._tiered:
+            return
+        from localai_tpu.ops.paged import BLOCK
+
+        for i, s in enumerate(self._slots):
+            if s is None:
+                continue
+            pol = self._slot_policy[i]
+            if pol is None or not pol.windowed:
+                continue
+            n = (s.prompt_len + s.generated - s.shifted if s.prefilled
+                 else s.prefill_pos)
+            sb = int(self._kv_sb[i])
+            lim = n - int(self._kv_window[i])
+            while True:
+                raw = int(self._demote_next[i])
+                if raw < sb or (raw + 1) * BLOCK > lim:
+                    break
+                self._demote_next[i] = raw + 1
+                if not self._cold or not self._cold_free:
+                    self.metrics["kv_evictions"] += 1
+                    continue
+                ci = self._cold_free.pop()
+                col = sb + (raw - sb) % max(int(self._kv_rw[i]), 1)
+                pb = int(self._table[i, col])
+                self._cold_table[i, raw] = ci
+                self._slot_cold[i].append(ci)
+                self.metrics["kv_cold_blocks"] += 1
+                self._dev_demote(pb, ci)
+
     def step(self) -> bool:
         """One engine iteration. In pipelined mode (the default, grammar-free)
         one decode step stays in flight: step N+1 is dispatched before step
@@ -2049,6 +2345,8 @@ class Engine:
         before the next sample). Returns True while work remains."""
         if self._draft is not None:
             return self._step_spec()
+        if self._tiered:
+            self._kv_tick()
         if self._ragged_now() and self._step_ragged():
             # mixed tick: decode + prefill ran as one ragged dispatch,
             # consumed synchronously (no pending survives a ragged tick)
@@ -2202,6 +2500,17 @@ class Engine:
     # into its own table (refcounted, copy-on-write: a borrower only ever
     # writes positions past the shared prefix, which live in fresh blocks).
 
+    def _req_policy(self, req: GenRequest):
+        """Effective retention policy for `req` (before pressure demotion).
+        Falls back to the engine policy on a malformed request policy —
+        submit() already rejected those; this keeps _blocks_for total."""
+        from localai_tpu.engine import kvtier
+
+        try:
+            return kvtier.resolve_policy(req.kv_policy, self._kv_policy)
+        except ValueError:
+            return self._kv_policy
+
     def _blocks_for(self, req: GenRequest) -> int:
         from localai_tpu.ops.paged import blocks_needed
 
@@ -2213,7 +2522,16 @@ class Engine:
             margin = max(margin, self.ec.gamma + 1)
         tokens = min(len(req.prompt_ids) + max(req.max_tokens, 0) + margin,
                      self.ec.max_context)
-        return blocks_needed(tokens)
+        need = blocks_needed(tokens)
+        if self._tiered:
+            # retention bounds residency: the compact table holds at most
+            # sink+ring columns per slot however long the sequence runs
+            # (the ring reuses its blocks in place), and a full-policy
+            # request larger than the table demotes to the engine window at
+            # admission — so a ctx-64k request under sink_window is NOT
+            # rejected for blocks it will never hold resident
+            need = min(need, self._maxb)
+        return need
 
     def _ref_blocks(self, blocks):
         for pb in blocks:
@@ -2504,9 +2822,13 @@ class Engine:
         if slot.matcher is not None:
             self._mask_host[idx] = 0xFF
             self._grammar_slots -= 1
+        windowed = False
+        if self._tiered:
+            pol = self._slot_policy[idx]
+            windowed = pol is not None and pol.windowed
         if self._paged:
             if (self.ec.prompt_cache and slot.shifted == 0
-                    and self._draft is None):
+                    and self._draft is None and not windowed):
                 # retain ONLY the blocks holding cached rows as the warm
                 # prefix cache (reclaimable oldest-first, _take_blocks); the
                 # unused tail of the reservation returns to the pool now.
@@ -2538,10 +2860,29 @@ class Engine:
                             self._block_hash_of[pb] = h
                 self._released_lru.append(idx)
             else:
+                # windowed slots land here too: ring columns hold position-
+                # rotated content no other tenant can address, so nothing is
+                # retained or hash-registered — every block returns NOW
                 self._unref_blocks(self._slot_blocks[idx])
                 self._slot_blocks[idx] = []
                 self._table[idx, :] = 0
             self._blocks_freed = True
+        if self._tiered:
+            # reset the slot's geometry to the full-policy sentinels (the
+            # in-flight pipelined dispatch captured ITS OWN copy at
+            # dispatch time — _kvt materializes per call)
+            self._kv_sb[idx] = self._maxb
+            self._kv_rw[idx] = 1
+            self._kv_sinks[idx] = self.ec.max_context
+            self._kv_window[idx] = self.ec.max_context
+            self._slot_policy[idx] = None
+            self._demote_next[idx] = 0
+            if self._cold:
+                for ci in self._slot_cold[idx]:
+                    self._cold_free.append(ci)
+                self._slot_cold[idx] = []
+                self._cold_table[idx, :] = 0
+            self._note_pool()
         # record what this slot's cache still holds (valid rows 0..len-1) so
         # a future prompt sharing the prefix skips that part of its prefill.
         # Shifted slots moved rows — their mapping is no longer positional.
@@ -2549,7 +2890,8 @@ class Engine:
         # while the injected embeddings differ per image, so positional
         # prefix-matching on ids would reuse the WRONG image's KV)
         if (self.ec.prompt_cache and self._draft is None
-                and slot.shifted == 0 and slot.req.mm_embeds is None):
+                and slot.shifted == 0 and slot.req.mm_embeds is None
+                and not windowed):
             kept = (list(slot.req.prompt_ids) + slot.gen_ids)[
                 : self.ec.max_context - 2]
             self._slot_kv_tokens[idx] = kept

@@ -256,7 +256,7 @@ def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int, dtype=None,
 
 
 def _cache_write(kc, vc, k, v, rows, positions, table=None, unique=True,
-                 redirect=None):
+                 redirect=None, kvt=None):
     """Scatter window K/V [B, S, KVH, D] into head-major caches [B', KVH, T, D]
     at (rows[b], :, positions[b, s]). With a paged `table` [B, MAXB] the cache
     is a block pool [NB, KVH, BS, D] and (slot, position) resolves to
@@ -280,7 +280,16 @@ def _cache_write(kc, vc, k, v, rows, positions, table=None, unique=True,
     REAL: batched admission pads groups by repeating a plan
     (engine._flush_admits), and a final prefill chunk's padded tail
     positions resolve to shared trash offsets — don't lie to the compiler
-    on those paths (both are per-request, not per-token)."""
+    on those paths (both are per-request, not per-token).
+
+    kvt (paged only, KV lifecycle tier — engine/kvtier.py): per-slot
+    residency arrays {"sb": [B], "rw": [B], ...}; raw block indices are
+    ring-mapped (ops/paged.ring_block_map) before the table lookup, so a
+    windowed slot's writes reuse its O(window) ring columns in place.
+    Full-policy slots carry the identity sentinel — same program, no
+    recompile across policy mixes. Uniqueness survives the mapping: the
+    ring's wrap period (rw*BLOCK tokens) exceeds any single write window
+    by construction (kvtier.ring_blocks margins)."""
     kvh = kc.shape[1]
     if table is None:
         idx = (rows[:, None, None], jnp.arange(kvh)[None, :, None],
@@ -288,7 +297,13 @@ def _cache_write(kc, vc, k, v, rows, positions, table=None, unique=True,
     else:
         from localai_tpu.ops.paged import BLOCK
 
-        pb = table[rows[:, None], positions // BLOCK]      # [B, S] physical
+        raw = positions // BLOCK
+        if kvt is not None:
+            from localai_tpu.ops.paged import ring_block_map
+
+            raw = ring_block_map(raw, kvt["sb"][rows][:, None],
+                                 kvt["rw"][rows][:, None])
+        pb = table[rows[:, None], raw]                     # [B, S] physical
         off = positions % BLOCK
         if redirect is not None:
             # distinct per-(row, window-pos) trash offsets: collision-free
@@ -408,11 +423,82 @@ def _seq_ax():
     return "seq" if seq_axis_size(current_mesh()) > 1 else None
 
 
-def _decode_dq(q, kc, vc, lengths, sliding_window=None, table=None):
+def _tiered_kv(kc, vc, table_rows, sb, rw, length, ctab=None, ck=None,
+               cv=None):
+    """Materialize the RESIDENT (ring-mapped) cache view for the KV
+    lifecycle tier (engine/kvtier.py): the per-slot table gather
+    [B, MAXB*BS] plus explicit true positions and row validity, optionally
+    concatenated with the dequantized int8 cold tier.
+
+    table_rows [B, MAXB]; sb/rw/length [B] (already row-indexed by the
+    caller). ctab [B, MAXB_FULL] (quantize_cold): cold block per raw
+    virtual block, 0 = not demoted; ck/cv are the cold QuantKV pools for
+    this layer. Demoted blocks drop out of the hot view (their ring column
+    may already hold a newer generation's rows) and are read from the cold
+    pool at their true positions instead. Returns
+    (k [B, KVH, T, D], v, pos [B, T], ok [B, T]) — `ok` covers residency +
+    freshness (+ demotion state); retention masking (window/sinks) is the
+    attention caller's layer."""
+    from localai_tpu.ops.paged import (
+        BLOCK, paged_view, resident_block_positions, resident_row_positions,
+    )
+
+    maxb = table_rows.shape[1]
+    kr, vr = paged_view(kc, table_rows), paged_view(vc, table_rows)
+    pos, ok = resident_row_positions(maxb, sb, rw, length)
+    k, v = dequant(kr), dequant(vr)
+    if ctab is not None:
+        b = pos.shape[0]
+        mb_full = ctab.shape[1]
+        raw, _ = resident_block_positions(maxb, sb, rw, length)
+        demoted = ctab != 0                                # [B, MAXB_FULL]
+        hot_dem = jnp.take_along_axis(
+            demoted, jnp.clip(raw, 0, mb_full - 1), axis=1)
+        hot_dem = hot_dem & (raw >= 0) & (raw < mb_full)   # [B, MAXB]
+        keep = jnp.broadcast_to(~hot_dem[:, :, None],
+                                (b, maxb, BLOCK)).reshape(b, maxb * BLOCK)
+        ok = ok & keep
+        ckr = paged_view(ck, ctab)
+        cvr = paged_view(cv, ctab)
+        posc = jnp.arange(mb_full * BLOCK, dtype=jnp.int32)[None, :]
+        okc = jnp.broadcast_to(demoted[:, :, None],
+                               (b, mb_full, BLOCK)).reshape(b,
+                                                            mb_full * BLOCK)
+        okc = okc & (posc < length[:, None])
+        k = jnp.concatenate([k, dequant(ckr).astype(k.dtype)], axis=2)
+        v = jnp.concatenate([v, dequant(cvr).astype(v.dtype)], axis=2)
+        pos = jnp.concatenate(
+            [pos, jnp.broadcast_to(posc, (b, mb_full * BLOCK))], axis=1)
+        ok = jnp.concatenate([ok, okc], axis=1)
+    return k, v, pos, ok
+
+
+def _decode_dq(q, kc, vc, lengths, sliding_window=None, table=None,
+               kvt=None, ck=None, cv=None):
     """XLA decode attention over a (possibly quantized) cache: dequant is
     fused into the consuming dots by XLA; quantized caches still halve HBM
     capacity on this path. A paged cache is materialized per layer via
-    gather (reference tier — the Pallas kernels stream through the table)."""
+    gather (reference tier — the Pallas kernels stream through the table).
+
+    kvt (KV lifecycle tier, engine/kvtier.py): per-slot residency arrays —
+    the gather covers only the RESIDENT ring view (O(sinks+window) rows for
+    windowed slots, identity for full-policy slots in the same program) and
+    the mask derives from true ring positions; with quantize_cold (ck/cv —
+    this layer's cold pools) the exited-window blocks attend from the int8
+    cold tier instead of being dropped."""
+    if kvt is not None:
+        from localai_tpu.ops.attention import mha_decode_masked
+
+        cold = "cold_tab" in kvt
+        k, v, pos, ok = _tiered_kv(
+            kc, vc, table, kvt["sb"], kvt["rw"], lengths,
+            ctab=kvt["cold_tab"] if cold else None, ck=ck, cv=cv)
+        if cold:
+            mask = ok  # demotion state decides hot vs cold; nothing evicted
+        else:
+            mask = ok & ((pos >= (lengths - kvt["window"])[:, None])
+                         | (pos < kvt["sinks"][:, None]))
+        return mha_decode_masked(q, k, v, mask)
     if table is not None:
         from localai_tpu.ops.paged import paged_view
 
@@ -500,7 +586,18 @@ def _attn_impls(cfg: LlamaConfig | None = None, kv_quant: bool = False):
             flash_prefill, ragged_decode, ragged_decode_q8,
         )
 
-        def attn_decode(q, kc, vc, lengths, sliding_window=None, table=None):
+        def attn_decode(q, kc, vc, lengths, sliding_window=None, table=None,
+                        kvt=None, ck=None, cv=None):
+            if kvt is not None:
+                # KV lifecycle tier: the ring-position/tier-map read rides
+                # the XLA reference path for now — the Pallas decode kernel
+                # has no per-slot ring-geometry scalar prefetch yet (the
+                # WRITE side is kernel-native: paged_scatter's targets are
+                # ring-mapped before the DMA kernel). TODO(kvtier): teach
+                # _decode_kernel the ring map + per-block dtype tier.
+                return _decode_dq(q, kc, vc, lengths,
+                                  sliding_window=sliding_window, table=table,
+                                  kvt=kvt, ck=ck, cv=cv)
             if isinstance(kc, QuantKV):
                 return ragged_decode_q8(q, kc.q, kc.s, vc.q, vc.s, lengths,
                                         sliding_window=sliding_window,
@@ -515,7 +612,7 @@ def _attn_impls(cfg: LlamaConfig | None = None, kv_quant: bool = False):
 
 
 def prefill(params, cfg: LlamaConfig, tokens, lengths, cos, sin,
-            k_cache, v_cache, slot_map, table=None, inject=None):
+            k_cache, v_cache, slot_map, table=None, inject=None, kvt=None):
     """Process padded prompt batch, writing K/V into slot rows of the cache.
 
     tokens: [B, S] i32 (padded); lengths: [B]; slot_map: [B] i32 — which cache
@@ -528,6 +625,20 @@ def prefill(params, cfg: LlamaConfig, tokens, lengths, cos, sin,
     """
     b, s = tokens.shape
     attn_prefill, _ = _attn_impls(cfg)
+    if kvt is not None:
+        # KV lifecycle tier: first-chunk self-attention under the per-slot
+        # sink+window retention mask (engine/kvtier.py). quantize_cold slots
+        # keep full causal coverage (exited content is demoted, not
+        # dropped), so the window term is lifted to a sentinel there.
+        from localai_tpu.ops.attention import mha_prefill_tiered
+
+        _sinks = kvt["sinks"][slot_map]
+        _window = kvt["window"][slot_map]
+        if "cold_tab" in kvt:
+            _window = jnp.full_like(_window, jnp.int32(1 << 30))
+
+        def attn_prefill(q, k, v, lengths, sliding_window=None):  # noqa: F811
+            return mha_prefill_tiered(q, k, v, lengths, _sinks, _window)
     positions = jnp.arange(s)[None, :].repeat(b, 0)
     sax = _seq_ax()
     x = params["embed"].astype(cfg.jdtype)[tokens]
@@ -552,7 +663,7 @@ def prefill(params, cfg: LlamaConfig, tokens, lengths, cos, sin,
         # unique=False: batched admission pads groups by repeating a real
         # request's plan (engine _flush_admits), so slot_map can repeat
         kc, vc = _cache_write(kc, vc, k, v, slot_map, positions, table,
-                              unique=False)
+                              unique=False, kvt=kvt)
         return x, (kc, vc)
 
     x, (k_cache, v_cache) = jax.lax.scan(
@@ -567,7 +678,7 @@ def prefill(params, cfg: LlamaConfig, tokens, lengths, cos, sin,
 
 
 def decode_step(params, cfg: LlamaConfig, tokens, lengths, cos, sin,
-                k_cache, v_cache, active=None, table=None):
+                k_cache, v_cache, active=None, table=None, kvt=None):
     """One continuous-batching decode step over ALL slots.
 
     tokens: [B] i32 — last sampled token per slot; lengths: [B] — cache entries
@@ -614,9 +725,19 @@ def decode_step(params, cfg: LlamaConfig, tokens, lengths, cos, sin,
         write_mesh = current_mesh()
     x = params["embed"].astype(cfg.jdtype)[tokens][:, None, :]  # [B,1,H]
     x = _shard_act(x, P("data", None, None))
+    # KV lifecycle tier: the cold pools (per-layer, like kc/vc) ride the scan
+    # as extra READ-ONLY xs — the demote copy is a separate host-driven jit
+    # (engine._demote_fn), so ys stays (kc, vc)
+    cold = kvt is not None and "cold_tab" in kvt
+    sb = rw = None
+    if kvt is not None:
+        sb, rw = kvt["sb"], kvt["rw"]
 
     def layer(x, xs):
-        lp, kc, vc = xs
+        if cold:
+            lp, kc, vc, ck, cv = xs
+        else:
+            (lp, kc, vc), ck, cv = xs, None, None
         h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
         q, k, v = _qkv(h, lp, cfg, spec=P("data", None, "model"))
         q = apply_rope(q, cos, sin, positions)
@@ -632,33 +753,36 @@ def decode_step(params, cfg: LlamaConfig, tokens, lengths, cos, sin,
                 if write_mesh is not None:
                     kq, ks, vq, vs = paged_scatter_append_q8_sharded(
                         write_mesh, kc.q, kc.s, vc.q, vc.s, k[:, 0], v[:, 0],
-                        lengths, table, active)
+                        lengths, table, active, sb=sb, rw=rw)
                 else:
                     kq, ks, vq, vs = paged_scatter_append_q8(
                         kc.q, kc.s, vc.q, vc.s, k[:, 0], v[:, 0], lengths,
-                        table, active)
+                        table, active, sb=sb, rw=rw)
                 kc, vc = QuantKV(kq, ks), QuantKV(vq, vs)
             elif write_mesh is not None:
                 kc, vc = paged_scatter_append_sharded(
                     write_mesh, kc, vc, k[:, 0], v[:, 0], lengths, table,
-                    active)
+                    active, sb=sb, rw=rw)
             else:
                 kc, vc = paged_scatter_append(kc, vc, k[:, 0], v[:, 0],
-                                              lengths, table, active)
+                                              lengths, table, active,
+                                              sb=sb, rw=rw)
         else:
             kc, vc = _cache_write(kc, vc, k, v, jnp.arange(b), wpos, table,
-                                  unique=unique, redirect=redirect)
+                                  unique=unique, redirect=redirect, kvt=kvt)
         attn = attn_decode(q, kc, vc, lengths + 1,
-                           sliding_window=cfg.sliding_window, table=table)
+                           sliding_window=cfg.sliding_window, table=table,
+                           kvt=kvt, ck=ck, cv=cv)
         x = x + qmatmul(attn.reshape(b, 1, -1), lp["wo"],
                         spec=P("data", None, None))
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         x = x + _mlp(h, lp, cfg, spec_prefix=("data", None))
         return x, (kc, vc)
 
-    x, (k_cache, v_cache) = jax.lax.scan(
-        layer, x, (params["layers"], k_cache, v_cache)
-    )
+    xs = (params["layers"], k_cache, v_cache)
+    if cold:
+        xs = xs + (kvt["cold_k"], kvt["cold_v"])
+    x, (k_cache, v_cache) = jax.lax.scan(layer, x, xs)
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     logits = _lm_head(x[:, 0].astype(jnp.float32), params)
     return logits, k_cache, v_cache
@@ -666,7 +790,7 @@ def decode_step(params, cfg: LlamaConfig, tokens, lengths, cos, sin,
 
 def ragged_forward(params, cfg: LlamaConfig, tokens, cos, sin,
                    k_cache, v_cache, block_seq, qstart, qlen, kvlen,
-                   tables, logit_rows):
+                   tables, logit_rows, kvt=None):
     """Mixed prefill+decode forward over ONE flat token stream (ragged
     continuous batching, arXiv:2604.15464): decode tokens and chunked-prefill
     windows from different requests pack into a single [T] stream and run as
@@ -727,7 +851,14 @@ def ragged_forward(params, cfg: LlamaConfig, tokens, cos, sin,
     live = (sid >= 0) & (rows >= qstart[s]) & (rows < qstart[s] + qlen[s])
     pos = kvlen[s] - qlen[s] + (rows - qstart[s])
     pos = jnp.where(live, jnp.clip(pos, 0, cos.shape[0] - 1), 0)
-    pb = jnp.where(live, tables[s, pos // blk], 0)
+    raw = pos // blk
+    if kvt is not None:
+        # KV lifecycle tier: fold raw blocks into the per-sequence ring
+        # before the table lookup (kvt ships [NSEQ] geometry, like tables)
+        from localai_tpu.ops.paged import ring_block_map
+
+        raw = ring_block_map(raw, kvt["sb"][s], kvt["rw"][s])
+    pb = jnp.where(live, tables[s, raw], 0)
     off = jnp.where(live, pos % blk, rows % blk)
 
     def write(kc, vc, kn, vn):
@@ -752,6 +883,17 @@ def ragged_forward(params, cfg: LlamaConfig, tokens, cos, sin,
 
     def attend(qf, kc, vc):
         sw = cfg.sliding_window
+        if kvt is not None:
+            # tiered reads ride the XLA twins (ring positions + retention
+            # masking); the ragged kernel's table streaming has no ring
+            # inverse yet. TODO(kvtier): _kv_map + _row_mask ring support.
+            if kv_quant:
+                return ragged_attention_xla_q8(
+                    qf, kc.q, kc.s, vc.q, vc.s, block_seq, qstart, qlen,
+                    kvlen, tables, sliding_window=sw, kvt=kvt)
+            return ragged_attention_xla(qf, kc, vc, block_seq, qstart,
+                                        qlen, kvlen, tables,
+                                        sliding_window=sw, kvt=kvt)
         if use_kernel and kv_quant:
             if mesh is not None:
                 return ragged_paged_attention_q8_sharded(
@@ -842,7 +984,7 @@ def build_decode_loop(step_fn, *, max_steps: int, limit: int):
 
     def decode_loop(params, cos, sin, kc, vc, sampler, last_logits, lengths,
                     active, remaining, check_eos, eos_ids, table=None,
-                    fast_width=None):
+                    fast_width=None, kvt=None):
         B = lengths.shape[0]
         init = (
             jnp.int32(0),                            # steps run
@@ -864,7 +1006,7 @@ def build_decode_loop(step_fn, *, max_steps: int, limit: int):
             prev_key = sampler.key
             tokens, lp, kc, vc, sampler, logits, lengths = step_fn(
                 params, cos, sin, kc, vc, sampler, last_logits, lengths,
-                live, None, fast_width, table)
+                live, None, fast_width, table, kvt)
             # freeze finished slots: their key stream and last_logits hold
             # at the finishing token (step_fn already gates lengths and
             # token_counts on the active mask)
@@ -924,7 +1066,8 @@ def hidden_states(params, cfg: LlamaConfig, tokens, lengths=None):
 
 def extend(params, cfg: LlamaConfig, tokens, start, cos, sin,
            k_cache, v_cache, slot_map=None, with_logits=True, last_pos=None,
-           table=None, inject=None, full_window=False, redirect=None):
+           table=None, inject=None, full_window=False, redirect=None,
+           kvt=None):
     """Forward a window of S tokens per slot starting at cache offset
     `start` [B] — the speculative-decoding verification pass (reference knob:
     DraftModel/NDraft, /root/reference/backend/backend.proto:218,150) and the
@@ -938,7 +1081,7 @@ def extend(params, cfg: LlamaConfig, tokens, start, cos, sin,
     the hidden state at that window position → logits [B, V], avoiding the
     [B, S, V] buffer when a single row is wanted (final prefill chunk).
     """
-    from localai_tpu.ops.attention import mha_extend
+    from localai_tpu.ops.attention import mha_extend, mha_extend_tiered
 
     b, s = tokens.shape
     rows = jnp.arange(b) if slot_map is None else slot_map
@@ -949,9 +1092,19 @@ def extend(params, cfg: LlamaConfig, tokens, start, cos, sin,
         # (see prefill's inject)
         extra, is_embed = inject
         x = jnp.where(is_embed[..., None], extra.astype(x.dtype), x)
+    # KV lifecycle tier (engine/kvtier.py): chunk windows write through the
+    # ring map and attend against the resident view at true positions.
+    # Padded final-chunk tails land in ring margin columns (never the live
+    # window — kvtier.ring_blocks reserves a full prefill chunk of margin)
+    # at positions > every real query, so the kv_pos <= q_pos mask hides
+    # them until real tokens overwrite those rows.
+    cold = kvt is not None and "cold_tab" in kvt
 
     def layer(x, xs):
-        lp, kc, vc = xs
+        if cold:
+            lp, kc, vc, ck, cv = xs
+        else:
+            (lp, kc, vc), ck, cv = xs, None, None
         h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
         q, k, v = _qkv(h, lp, cfg, spec=P("data", None, "model"))
         q = apply_rope(q, cos, sin, positions)
@@ -972,26 +1125,37 @@ def extend(params, cfg: LlamaConfig, tokens, start, cos, sin,
             kc, vc, k, v, rows, positions, table,
             unique=(table is None or full_window or redirect is not None)
             and red_ok,
-            redirect=redirect)
-        if table is not None:
-            from localai_tpu.ops.paged import paged_view
-
-            kr = paged_view(kc, table[rows])
-            vr = paged_view(vc, table[rows])
+            redirect=redirect, kvt=kvt)
+        if kvt is not None:
+            kr, vr, kv_pos, kv_ok = _tiered_kv(
+                kc, vc, table[rows], kvt["sb"][rows], kvt["rw"][rows],
+                start + s,
+                ctab=kvt["cold_tab"][rows] if cold else None, ck=ck, cv=cv)
+            attn = mha_extend_tiered(
+                q, kr, vr, positions, kv_pos, kv_ok,
+                kvt["sinks"][rows], kvt["window"][rows],
+                drop_window=not cold)
         else:
-            kr = kc if slot_map is None else kc[rows]
-            vr = vc if slot_map is None else vc[rows]
-        attn = mha_extend(q, dequant(kr), dequant(vr), positions,
-                          sliding_window=cfg.sliding_window)
+            if table is not None:
+                from localai_tpu.ops.paged import paged_view
+
+                kr = paged_view(kc, table[rows])
+                vr = paged_view(vc, table[rows])
+            else:
+                kr = kc if slot_map is None else kc[rows]
+                vr = vc if slot_map is None else vc[rows]
+            attn = mha_extend(q, dequant(kr), dequant(vr), positions,
+                              sliding_window=cfg.sliding_window)
         x = x + qmatmul(attn.reshape(b, s, -1), lp["wo"],
                         spec=P("data", None, None))
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         x = x + _mlp(h, lp, cfg, spec_prefix=("data", None))
         return x, (kc, vc)
 
-    x, (k_cache, v_cache) = jax.lax.scan(
-        layer, x, (params["layers"], k_cache, v_cache)
-    )
+    xs = (params["layers"], k_cache, v_cache)
+    if cold:
+        xs = xs + (kvt["cold_k"], kvt["cold_v"])
+    x, (k_cache, v_cache) = jax.lax.scan(layer, x, xs)
     if not with_logits:
         return None, k_cache, v_cache
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)

@@ -91,6 +91,90 @@ def mha_extend(q, k_cache, v_cache, q_positions, *, scale=None,
     return out.reshape(b, s, h, d)
 
 
+def mha_prefill_tiered(q, k, v, lengths, sinks, window, *, scale=None,
+                       softcap=None):
+    """mha_prefill with a PER-SLOT attention-sink + sliding-window mask
+    (KV lifecycle tier, engine/kvtier.py): query at position p attends key
+    at position t iff t <= p and (t > p - window[b] or t < sinks[b]).
+    Full-policy slots ship sentinel window/sinks >= S and reduce to the
+    plain causal mask. sinks/window: [B] int32."""
+    b, s, h, d = q.shape
+    kvh = k.shape[2]
+    scale = scale if scale is not None else d ** -0.5
+
+    qg = _group_query_heads(q, kvh)
+    logits = jnp.einsum("bskgd,btkd->bkgst", qg, k).astype(jnp.float32) * scale
+    logits = _softcap(logits, softcap)
+
+    pos = jnp.arange(s)
+    causal = pos[:, None] >= pos[None, :]                      # [S,T]
+    valid = pos[None, :] < lengths[:, None]                    # [B,T]
+    mask = causal[None, :, :] & valid[:, None, :]              # [B,S,T]
+    keep = (pos[None, None, :] > pos[None, :, None]
+            - window[:, None, None]) \
+        | (pos[None, None, :] < sinks[:, None, None])
+    logits = jnp.where((mask & keep)[:, None, None, :, :], logits, NEG_INF)
+
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bkgst,btkd->bskgd", probs, v)
+    return out.reshape(b, s, h, d)
+
+
+def mha_extend_tiered(q, k_cache, v_cache, q_positions, kv_positions, kv_ok,
+                      sinks, window, *, scale=None, drop_window=True):
+    """mha_extend against a RESIDENT (ring-mapped) cache view whose rows
+    carry explicit true positions (kv_positions [B, T]) and validity
+    (kv_ok [B, T] — residency + freshness, ops/paged.resident_row_positions
+    plus any cold-tier extension the caller concatenated).
+
+    drop_window=True applies the sink_window retention mask per query
+    (dropped-block semantics); False keeps every valid row <= the query —
+    the quantize_cold case, where exited-window content is still readable
+    (at int8) rather than evicted. sinks/window: [B] int32."""
+    b, s, h, d = q.shape
+    kvh = k_cache.shape[1]
+    scale = scale if scale is not None else d ** -0.5
+
+    qg = _group_query_heads(q, kvh)                             # [B,S,KVH,G,D]
+    logits = jnp.einsum("bskgd,bktd->bkgst", qg,
+                        k_cache).astype(jnp.float32) * scale
+
+    mask = kv_ok[:, None, :] & (kv_positions[:, None, :]
+                                <= q_positions[:, :, None])     # [B,S,T]
+    if drop_window:
+        mask = mask & (
+            (kv_positions[:, None, :] > q_positions[:, :, None]
+             - window[:, None, None])
+            | (kv_positions[:, None, :] < sinks[:, None, None]))
+    logits = jnp.where(mask[:, None, None, :, :], logits, NEG_INF)
+
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bkgst,bktd->bskgd", probs, v_cache)
+    return out.reshape(b, s, h, d)
+
+
+def mha_decode_masked(q, k_cache, v_cache, kv_mask, *, scale=None,
+                      softcap=None):
+    """Single-token decode attention with a caller-built per-row mask
+    [B, T] instead of the implicit arange(T) < lengths — the KV-lifecycle
+    read path, where the cache view is ring-mapped (+ optionally
+    concatenated with the cold tier) and row validity is a function of
+    residency, true position, window membership, and demotion state."""
+    b, _, h, d = q.shape
+    kvh = k_cache.shape[1]
+    scale = scale if scale is not None else d ** -0.5
+
+    qg = _group_query_heads(q, kvh)[:, 0]                       # [B,KVH,G,D]
+    logits = jnp.einsum("bkgd,bktd->bkgt", qg,
+                        k_cache).astype(jnp.float32) * scale
+    logits = _softcap(logits, softcap)
+    logits = jnp.where(kv_mask[:, None, None, :], logits, NEG_INF)
+
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bkgt,bktd->bkgd", probs, v_cache)
+    return out.reshape(b, 1, h, d)
+
+
 def mha_decode(q, k_cache, v_cache, lengths, *, scale=None, softcap=None,
                sliding_window=None):
     """Single-token decode attention against a slot-contiguous KV cache.

@@ -69,3 +69,63 @@ def paged_view(cache, table):
 def blocks_needed(tokens: int) -> int:
     """Virtual blocks required to hold `tokens` cache rows."""
     return -(-tokens // BLOCK)
+
+
+# --------------------------------------------------------------- KV lifecycle
+# Ring-mapped compact residency (engine/kvtier.py): under a
+# sink_window(sinks, window) retention policy a slot keeps only
+# sink_blocks identity-mapped table columns plus a ring of ring_blocks
+# columns that the write path reuses in place — O(sinks + window) resident
+# blocks for any context length. Every function below is pure device
+# arithmetic over per-slot runtime arrays (sink_blocks `sb`, ring width
+# `rw`), so ONE compiled program serves any mix of full and windowed slots:
+# full-policy slots ship the sentinel sb >= table width, which makes the
+# mapping the identity and every block valid.
+
+
+def ring_block_map(raw_block, sb, rw):
+    """Raw (virtual) block index -> resident table column.
+
+    raw_block: int32 array of position//BLOCK values; sb/rw broadcastable
+    against it. Identity for raw_block < sb (sinks, and everything under the
+    full-policy sentinel); blocks at/after the sinks land in the ring."""
+    rw = jnp.maximum(rw, 1)
+    return jnp.where(raw_block < sb, raw_block, sb + (raw_block - sb) % rw)
+
+
+def resident_block_positions(maxb: int, sb, rw, length):
+    """Which raw block each table column currently holds, and whether it is
+    a live resident — the read-side inverse of ring_block_map.
+
+    sb/rw/length: [B] int32. Returns (raw [B, maxb] int32, ok [B, maxb]
+    bool). Ring column j >= sb holds the LARGEST raw block <= cur (the block
+    `length-1` lives in) mapping to it; columns the ring has not reached yet
+    (raw would precede the sinks) and columns past sb+rw are masked. Rows
+    with positions >= length inside a live block are the previous ring
+    generation's leftovers — callers mask them with `pos < length`."""
+    j = jnp.arange(maxb, dtype=jnp.int32)[None, :]
+    sb = sb[:, None].astype(jnp.int32)
+    rw = jnp.maximum(rw[:, None].astype(jnp.int32), 1)
+    cur = jnp.maximum(length[:, None].astype(jnp.int32) - 1, 0) // BLOCK
+    # ring offset of the current block, and of column j
+    m = (cur - sb) % rw
+    o = j - sb
+    raw_ring = cur - ((m - o) % rw)
+    raw = jnp.where(j < sb, j, raw_ring)
+    ok = (j < sb) | ((j < sb + rw) & (raw_ring >= sb))
+    return raw, ok
+
+
+def resident_row_positions(maxb: int, sb, rw, length):
+    """Per-row true positions + validity of the gathered resident view
+    ([B, maxb*BLOCK], matching paged_view's token axis). Validity here is
+    residency + `pos < length`; retention-policy masking (window/sinks,
+    demotion state) is layered on top by the attention caller."""
+    raw, okb = resident_block_positions(maxb, sb, rw, length)
+    b = raw.shape[0]
+    pos = (raw[:, :, None] * BLOCK
+           + jnp.arange(BLOCK, dtype=jnp.int32)[None, None, :])
+    pos = pos.reshape(b, maxb * BLOCK)
+    ok = jnp.broadcast_to(okb[:, :, None], (b, maxb, BLOCK))
+    ok = ok.reshape(b, maxb * BLOCK) & (pos < length[:, None])
+    return pos, ok

@@ -50,16 +50,27 @@ except ImportError:                   # 0.4.x spelling
     from jax.experimental.shard_map import shard_map as _shard_map
 
 
-def _targets(positions, table, active):
+def _targets(positions, table, active, sb=None, rw=None):
     """(physical block [B], in-block row [B]) for each slot's new token.
 
     Computed at trace time from the scalar-prefetched table — the kernel
     never sees an index it could fail to prove unique. Inactive rows route
     to the trash block at row `b % BS` (distinct while B <= BS, the same
-    bound the XLA redirect asserts — models/llama._cache_write)."""
+    bound the XLA redirect asserts — models/llama._cache_write).
+
+    sb/rw ([B] i32, optional): KV-lifecycle ring geometry
+    (ops/paged.ring_block_map) — windowed slots' raw block indices fold into
+    their O(window) ring columns before the table lookup, so the DMA kernel
+    itself needs no ring knowledge. Full-policy slots ship the identity
+    sentinel (sb >= table width)."""
     b = positions.shape[0]
     block = jnp.int32(_POOL_BS)
-    pb = table[jnp.arange(b), positions // block]
+    raw = positions // block
+    if sb is not None:
+        from localai_tpu.ops.paged import ring_block_map
+
+        raw = ring_block_map(raw, sb, rw)
+    pb = table[jnp.arange(b), raw]
     off = positions % block
     if active is not None:
         pb = jnp.where(active, pb, 0)
@@ -88,16 +99,17 @@ def _append_kernel(pb_ref, off_ref, knew_ref, vnew_ref, kin_ref, vin_ref,
 
 
 def paged_scatter_append(k_pool, v_pool, k_new, v_new, positions, table,
-                         active=None):
+                         active=None, sb=None, rw=None):
     """Append one K/V token per slot into the paged pools, in place.
 
     k_pool/v_pool: [NB, KVH, BS, D]; k_new/v_new: [B, KVH, D] (this step's
     rope-applied K and raw V rows); positions: [B] write position (= the
-    slot's current length); table: [B, MAXB] i32; active: [B] bool or None.
+    slot's current length); table: [B, MAXB] i32; active: [B] bool or None;
+    sb/rw: [B] i32 or None — KV-lifecycle ring geometry (see _targets).
     Returns the updated (k_pool, v_pool) — aliased, not copies.
     """
     b, kvh, d = k_new.shape
-    pb, off = _targets(positions, table, active)
+    pb, off = _targets(positions, table, active, sb=sb, rw=rw)
     kn = k_new.reshape(b, kvh, 1, d).astype(k_pool.dtype)
     vn = v_new.reshape(b, kvh, 1, d).astype(v_pool.dtype)
     return pl.pallas_call(
@@ -127,7 +139,8 @@ def _head_axis(mesh):
 
 
 def paged_scatter_append_sharded(mesh, k_pool, v_pool, k_new, v_new,
-                                 positions, table, active=None):
+                                 positions, table, active=None,
+                                 sb=None, rw=None):
     """TP wrapper: run the scatter-append kernel per-shard via shard_map
     over the pool's KV-head axis (models/llama.py paged_pool_spec).
 
@@ -142,6 +155,16 @@ def paged_scatter_append_sharded(mesh, k_pool, v_pool, k_new, v_new,
 
     ax = _head_axis(mesh)
     pool, new, rep = P(None, ax, None, None), P(None, ax, None), P()
+    # ring-map the write targets OUTSIDE shard_map (positions/table are
+    # replicated anyway) so the inner body stays one shape for every
+    # active/tier combination
+    if sb is not None:
+        from localai_tpu.ops.paged import ring_block_map
+
+        b = positions.shape[0]
+        raw = ring_block_map(positions // _POOL_BS, sb, rw)
+        table = table[jnp.arange(b), raw][:, None]       # [B, 1] direct map
+        positions = positions % _POOL_BS
     if active is None:
         return _shard_map(
             lambda kp, vp, kn, vn, pos, tab: paged_scatter_append(
@@ -158,7 +181,8 @@ def paged_scatter_append_sharded(mesh, k_pool, v_pool, k_new, v_new,
 
 
 def paged_scatter_append_q8_sharded(mesh, kq, ks, vq, vs, k_new, v_new,
-                                    positions, table, active=None):
+                                    positions, table, active=None,
+                                    sb=None, rw=None):
     """int8 twin of paged_scatter_append_sharded: the scale pools
     [NB, KVH, 1, BS] shard their KV-head axis alongside the int8 bodies."""
     from jax.sharding import PartitionSpec as P
@@ -166,6 +190,13 @@ def paged_scatter_append_q8_sharded(mesh, kq, ks, vq, vs, k_new, v_new,
     ax = _head_axis(mesh)
     pool = P(None, ax, None, None)
     new, rep = P(None, ax, None), P()
+    if sb is not None:
+        from localai_tpu.ops.paged import ring_block_map
+
+        b = positions.shape[0]
+        raw = ring_block_map(positions // _POOL_BS, sb, rw)
+        table = table[jnp.arange(b), raw][:, None]       # [B, 1] direct map
+        positions = positions % _POOL_BS
     specs4 = (pool, pool, pool, pool, new, new, rep, rep)
     if active is None:
         return _shard_map(
@@ -205,7 +236,7 @@ def _append_q8_kernel(pb_ref, off_ref, kq_new_ref, ks_new_ref, vq_new_ref,
 
 
 def paged_scatter_append_q8(kq, ks, vq, vs, k_new, v_new, positions, table,
-                            active=None):
+                            active=None, sb=None, rw=None):
     """int8 variant: pools kq/vq [NB, KVH, BS, D] int8 with scales ks/vs
     [NB, KVH, 1, BS] f32 (one aligned scale row per block — ops/paged.py).
     k_new/v_new arrive dense [B, KVH, D]; quantization happens here (one
@@ -213,7 +244,7 @@ def paged_scatter_append_q8(kq, ks, vq, vs, k_new, v_new, positions, table,
     from localai_tpu.ops.kvcache import quantize_tokens
 
     b, kvh, d = k_new.shape
-    pb, off = _targets(positions, table, active)
+    pb, off = _targets(positions, table, active, sb=sb, rw=rw)
     kq_n, ks_n = quantize_tokens(k_new)          # [B, KVH, D], [B, KVH]
     vq_n, vs_n = quantize_tokens(v_new)
     kq_n = kq_n.reshape(b, kvh, 1, d)

@@ -317,8 +317,14 @@ def ragged_paged_attention_q8(q, k_q, k_s, v_q, v_s, block_seq, qstart,
 # compare against.
 
 def _xla_core(q, kg, vg, block_seq, qstart, qlen, kvlen, sliding_window,
-              scale):
-    """q: [T, H, D]; kg/vg: [NQB, KVH, C, D] f32 per-q-block gathered KV."""
+              scale, tier=None):
+    """q: [T, H, D]; kg/vg: [NQB, KVH, C, D] f32 per-q-block gathered KV.
+
+    tier (KV lifecycle, engine/kvtier.py): (pos [NQB, C], ok [NQB, C],
+    sinks [NQB], window [NQB]) — the gathered view is ring-mapped, so kv row
+    positions come from ops/paged.resident_row_positions instead of
+    arange(C), and the retention mask (sink ∪ window) replaces the plain
+    length mask. ok already folds residency + pos < kvlen."""
     t, h, d = q.shape
     nqb, kvh, c, _ = kg.shape
     g = h // kvh
@@ -330,15 +336,39 @@ def _xla_core(q, kg, vg, block_seq, qstart, qlen, kvlen, sliding_window,
     grow = jnp.arange(t, dtype=jnp.int32).reshape(nqb, QBLK)
     q_pos = klen - ql + (grow - qs)                            # [NQB, QBLK]
     valid = (grow >= qs) & (grow < qs + ql) & (block_seq[:, None] >= 0)
-    kv_pos = jnp.arange(c, dtype=jnp.int32)[None, None, :]
-    mask = (valid[:, :, None] & (kv_pos <= q_pos[:, :, None])
-            & (kv_pos < klen[:, :, None]))
-    if sliding_window is not None:
-        mask &= kv_pos > (q_pos[:, :, None] - sliding_window)
+    if tier is None:
+        kv_pos = jnp.arange(c, dtype=jnp.int32)[None, None, :]
+        mask = (valid[:, :, None] & (kv_pos <= q_pos[:, :, None])
+                & (kv_pos < klen[:, :, None]))
+        if sliding_window is not None:
+            mask &= kv_pos > (q_pos[:, :, None] - sliding_window)
+    else:
+        pos, ok, sinks, window = tier
+        kv_pos = pos[:, None, :]                               # [NQB, 1, C]
+        mask = (valid[:, :, None] & ok[:, None, :]
+                & (kv_pos <= q_pos[:, :, None]))
+        mask &= ((kv_pos > q_pos[:, :, None] - window[:, None, None])
+                 | (kv_pos < sinks[:, None, None]))
     sc = jnp.where(mask[:, None, :, None, :], sc, NEG_INF)
     p = jax.nn.softmax(sc, axis=-1)
     out = jnp.einsum("nhqgc,nhcd->nqhgd", p, vg)
     return out.reshape(t, h, d).astype(q.dtype)
+
+
+def _tier_blocks(block_seq, kvlen, tables, kvt):
+    """Per-q-block tier metadata for _xla_core: true row positions +
+    residency of the ring-mapped gathered view. kvt holds per-SEQUENCE
+    [NSEQ] geometry arrays (engine ships them like tables)."""
+    if kvt is None:
+        return None
+    from localai_tpu.ops.paged import resident_row_positions
+
+    s_b = jnp.maximum(block_seq, 0).astype(jnp.int32)
+    pos, ok = resident_row_positions(
+        tables.shape[1], kvt["sb"].astype(jnp.int32)[s_b],
+        kvt["rw"].astype(jnp.int32)[s_b], kvlen.astype(jnp.int32)[s_b])
+    return (pos, ok, kvt["sinks"].astype(jnp.int32)[s_b],
+            kvt["window"].astype(jnp.int32)[s_b])
 
 
 def _gather_blocks(pool, block_seq, tables):
@@ -350,13 +380,14 @@ def _gather_blocks(pool, block_seq, tables):
 
 
 def ragged_attention_xla(q, k_pool, v_pool, block_seq, qstart, qlen, kvlen,
-                         tables, sliding_window=None):
+                         tables, sliding_window=None, kvt=None):
     kg = _gather_blocks(k_pool, block_seq, tables).astype(jnp.float32)
     vg = _gather_blocks(v_pool, block_seq, tables).astype(jnp.float32)
     return _xla_core(q, kg, vg, block_seq.astype(jnp.int32),
                      qstart.astype(jnp.int32), qlen.astype(jnp.int32),
                      kvlen.astype(jnp.int32), sliding_window,
-                     q.shape[-1] ** -0.5)
+                     q.shape[-1] ** -0.5,
+                     tier=_tier_blocks(block_seq, kvlen, tables, kvt))
 
 
 def _gather_scales(s_pool, block_seq, tables):
@@ -369,7 +400,7 @@ def _gather_scales(s_pool, block_seq, tables):
 
 
 def ragged_attention_xla_q8(q, k_q, k_s, v_q, v_s, block_seq, qstart, qlen,
-                            kvlen, tables, sliding_window=None):
+                            kvlen, tables, sliding_window=None, kvt=None):
     kg = (_gather_blocks(k_q, block_seq, tables).astype(jnp.float32)
           * _gather_scales(k_s, block_seq, tables)[..., None])
     vg = (_gather_blocks(v_q, block_seq, tables).astype(jnp.float32)
@@ -377,7 +408,8 @@ def ragged_attention_xla_q8(q, k_q, k_s, v_q, v_s, block_seq, qstart, qlen,
     return _xla_core(q, kg, vg, block_seq.astype(jnp.int32),
                      qstart.astype(jnp.int32), qlen.astype(jnp.int32),
                      kvlen.astype(jnp.int32), sliding_window,
-                     q.shape[-1] ** -0.5)
+                     q.shape[-1] ** -0.5,
+                     tier=_tier_blocks(block_seq, kvlen, tables, kvt))
 
 
 # -------------------------------------------------------- shard_map (TP)

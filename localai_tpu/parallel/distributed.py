@@ -53,6 +53,15 @@ def init_distributed(coordinator: str | None = None,
         process_id = int(pid) if pid is not None else None
     if not coordinator or not num_processes or num_processes <= 1:
         return 0
+    try:
+        # multiprocess CPU meshes need a cross-host collectives backend —
+        # without this, sharded device_put and any cross-process psum fail
+        # with "Multiprocess computations aren't implemented on the CPU
+        # backend". Must be set before the CPU client is created; a no-op
+        # for TPU backends.
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass   # older jaxlibs without gloo keep the previous behavior
     jax.distributed.initialize(coordinator_address=coordinator,
                                num_processes=num_processes,
                                process_id=process_id)

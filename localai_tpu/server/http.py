@@ -1598,6 +1598,8 @@ def run_server(args) -> int:
         breaker_cooldown=getattr(args, "breaker_cooldown", None),
         queue_depth=getattr(args, "queue_depth", None),
         drain_timeout=getattr(args, "drain_timeout", None),
+        kv_window=getattr(args, "kv_window", None),
+        kv_sinks=getattr(args, "kv_sinks", None),
     )
     for t in ("watchdog_idle_timeout", "watchdog_busy_timeout"):
         v = getattr(args, t, None)

@@ -1,0 +1,403 @@
+"""KV lifecycle tier (engine/kvtier.py + engine compact-ring geometry):
+attention-sink + sliding-window retention, quantized cold blocks, eviction
+and recompute accounting for long-context serving.
+
+Design per PAPERS.md attention-sink streaming (SnapStream) and sub-channel
+KV quantization (Transformer-Lite). Cheap policy/geometry/jaxpr checks run
+in tier-1; the engine-driving parity, refcount, and tripwire streams are
+slow-marked and run standalone via `-m longctx` (the CI slow lane picks
+them up through `-m slow`).
+"""
+import numpy as np
+import pytest
+
+from localai_tpu.engine import kvtier
+from localai_tpu.engine.kvtier import (
+    KVPolicy, engine_margin_tokens, parse_policy, resident_blocks,
+    resolve_policy, ring_blocks,
+)
+from localai_tpu.ops.paged import (
+    BLOCK, blocks_needed, resident_block_positions, ring_block_map,
+)
+
+pytestmark = pytest.mark.longctx
+
+TINY = dict(vocab_size=96, hidden_size=32, intermediate_size=64,
+            num_layers=2, num_heads=2, num_kv_heads=2, head_dim=16,
+            max_position=33280, dtype="float32")
+
+
+# ------------------------------------------------------------ policy layer
+
+
+def test_policy_parse():
+    assert parse_policy("") == KVPolicy()
+    assert parse_policy("full") == KVPolicy()
+    p = parse_policy("sink_window(sinks=256, window=1024)")
+    assert (p.kind, p.sinks, p.window, p.quantize_cold) == \
+        ("sink_window", 256, 1024, False)
+    assert p.windowed and p.sink_blocks == 2
+    q = parse_policy("sink_window(window=512, quantize_cold=true)")
+    assert q.sinks == 0 and q.quantize_cold
+    assert "quantize_cold" in q.describe()
+    for bad in ("lru", "sink_window", "sink_window()",
+                "sink_window(sinks=4)", "sink_window(window=-1)",
+                "sink_window(frobnicate=1)"):
+        with pytest.raises(ValueError):
+            parse_policy(bad)
+
+
+def test_resolve_policy_narrowing_only():
+    eng = parse_policy("sink_window(sinks=256, window=1024)")
+    # request may shrink the retention set
+    r = resolve_policy("sink_window(sinks=128, window=512)", eng)
+    assert (r.sinks, r.window) == (128, 512)
+    # full request under a windowed engine is fine (identity geometry)
+    assert not resolve_policy("full", eng).windowed
+    # widening past the engine geometry is rejected
+    with pytest.raises(ValueError):
+        resolve_policy("sink_window(sinks=512, window=1024)", eng)
+    with pytest.raises(ValueError):
+        resolve_policy("sink_window(sinks=256, window=4096)", eng)
+    # windowed request needs a windowed engine (no ring to ride)
+    with pytest.raises(ValueError):
+        resolve_policy("sink_window(sinks=0, window=256)", KVPolicy())
+    # quantize_cold is an engine property, inherited not per-request
+    c = resolve_policy("sink_window(sinks=128, window=512)",
+                       parse_policy("sink_window(sinks=256, window=1024, "
+                                    "quantize_cold=true)"))
+    assert c.quantize_cold
+
+
+def test_ring_geometry():
+    # ring = window span + write-ahead margin + partial/demote slack
+    assert ring_blocks(1024, 512) == 8 + 4 + 2
+    pol = parse_policy("sink_window(sinks=256, window=1024)")
+    assert resident_blocks(pol, 512) == 2 + 14
+    from localai_tpu.engine.engine import EngineConfig
+
+    ec = EngineConfig(prefill_chunk=256, decode_loop=64, decode_block=16)
+    assert engine_margin_tokens(ec) == 256
+
+
+def test_ring_block_map_roundtrip():
+    """Ring write map + resident read map agree: after writing raw blocks
+    0..n-1 through ring_block_map, resident_block_positions recovers
+    exactly the still-resident raw index for every table column."""
+    import jax.numpy as jnp
+
+    sb, rw, maxb = 2, 5, 7
+    for total in (3, 7, 12, 23):
+        # last writer wins per column — emulate the scatter stream
+        col_owner = {}
+        for raw in range(total):
+            vb = int(ring_block_map(jnp.asarray(raw), jnp.asarray(sb),
+                                    jnp.asarray(rw)))
+            if raw < sb:
+                assert vb == raw
+            else:
+                assert sb <= vb < sb + rw
+            col_owner[vb] = raw
+        length = total * BLOCK
+        raw_pos, ok = resident_block_positions(
+            maxb, jnp.asarray([sb]), jnp.asarray([rw]),
+            jnp.asarray([length]))
+        raw_pos, ok = np.asarray(raw_pos)[0], np.asarray(ok)[0]
+        for j in range(maxb):
+            if ok[j]:
+                assert col_owner.get(j) == raw_pos[j], (total, j)
+            else:
+                # a masked ring column was never written: any writer with
+                # raw in [sb, total) would have made it resident
+                assert col_owner.get(j) is None, (total, j)
+
+
+# ------------------------------------------------ admission / _blocks_for
+
+
+@pytest.fixture(scope="module")
+def tiny_parts():
+    import jax
+
+    from localai_tpu.models.llama import LlamaConfig, init_params
+
+    cfg = LlamaConfig(**TINY)
+    return cfg, init_params(cfg, jax.random.PRNGKey(0))
+
+
+def _engine(tiny_parts, **kw):
+    from localai_tpu.engine.engine import Engine, EngineConfig
+
+    cfg, params = tiny_parts
+    return Engine(cfg, params, None, EngineConfig(**kw))
+
+
+def test_blocks_for_respects_retention(tiny_parts):
+    """A ctx-4k request under sink_window admits against the RESIDENT
+    footprint, not the virtual context — the same pool rejects it under
+    the full policy, and that rejection names the policy."""
+    from localai_tpu.engine.engine import GenRequest
+    from localai_tpu.ops.sampling import SamplingParams
+
+    eng = _engine(tiny_parts, max_slots=1, max_context=4096,
+                  prefill_buckets=(16,), kv_pages=24,
+                  kv_policy="sink_window(sinks=256, window=512)")
+    assert eng._maxb == eng._kv_resident <= 23
+    # virtual blocks ~32 >> resident: must NOT raise
+    rid, out = eng.submit(GenRequest(list(range(1, 40)), SamplingParams(),
+                                     max_tokens=3900, ignore_eos=True))
+    assert rid >= 0 and out is not None
+    full = _engine(tiny_parts, max_slots=1, max_context=4096,
+                   prefill_buckets=(16,), kv_pages=24)
+    with pytest.raises(ValueError, match="KV blocks.*kv_policy full"):
+        full.submit(GenRequest(list(range(1, 40)), SamplingParams(),
+                               max_tokens=3900))
+
+
+def test_tiered_config_validation(tiny_parts):
+    with pytest.raises(ValueError, match="kv_pages"):
+        _engine(tiny_parts, kv_policy="sink_window(sinks=0, window=256)")
+    with pytest.raises(ValueError, match="kv_cold_pages"):
+        _engine(tiny_parts, kv_pages=64, kv_cold_pages=8)
+    with pytest.raises(ValueError, match="kv_cold_pages"):
+        _engine(tiny_parts, kv_pages=64, kv_cold_pages=1,
+                kv_policy="sink_window(sinks=0, window=256, "
+                          "quantize_cold=true)")
+    with pytest.raises(ValueError, match="cache_type"):
+        _engine(tiny_parts, kv_pages=64, kv_cold_pages=8, cache_type="int8",
+                kv_policy="sink_window(sinks=0, window=256, "
+                          "quantize_cold=true)")
+
+
+# ------------------------------------------------------------ jaxpr proof
+
+
+def test_tier_map_adds_no_full_pool_gather(tiny_parts):
+    """Structural proof for the compact-table contract: the tiered decode
+    step's jaxpr materializes the [maxb*BLOCK]-row gathered view and NO
+    intermediate sized by the full pool — gather cost is O(sinks+window)
+    regardless of kv_pages."""
+    import jax
+    import jax.numpy as jnp
+
+    from localai_tpu.models.llama import decode_step
+    from localai_tpu.ops.paged import init_paged
+    from localai_tpu.ops.rope import rope_table
+
+    cfg, params = tiny_parts
+    B, NB, MAXB = 2, 64, 6
+    kc, vc = init_paged(cfg.num_layers, NB, cfg.num_kv_heads, cfg.head_dim,
+                        jnp.float32)
+    cos, sin = rope_table(cfg.rope, 1024)
+    kvt = {"sb": jnp.ones((B,), jnp.int32),
+           "rw": jnp.full((B,), MAXB - 1, jnp.int32),
+           "sinks": jnp.full((B,), 128, jnp.int32),
+           "window": jnp.full((B,), 256, jnp.int32)}
+    jaxpr = jax.make_jaxpr(
+        lambda p, t, l, k, v, tab, kvt: decode_step(
+            p, cfg, t, l, cos, sin, k, v, table=tab, kvt=kvt)
+    )(params, jnp.ones((B,), jnp.int32), jnp.full((B,), 500, jnp.int32),
+      kc, vc, jnp.zeros((B, MAXB), jnp.int32), kvt)
+
+    full_rows = NB * BLOCK
+    compact_rows = MAXB * BLOCK
+    saw_compact = False
+
+    def walk(jx):
+        nonlocal saw_compact
+        for eqn in jx.eqns:
+            for var in eqn.outvars:
+                shape = getattr(var.aval, "shape", ())
+                assert full_rows not in shape, (
+                    f"full-pool-sized intermediate {shape} "
+                    f"from {eqn.primitive}")
+                if compact_rows in shape:
+                    saw_compact = True
+            for sub in jax.core.jaxprs_in_params(eqn.params):
+                walk(getattr(sub, "jaxpr", sub))
+
+    walk(jaxpr.jaxpr)
+    assert saw_compact, "expected a [maxb*BLOCK]-row gathered view"
+
+
+# ------------------------------------------------- engine-driving streams
+# (slow lane: each builds + compiles engines; runs via -m slow / -m longctx)
+
+
+def _drive(eng, reqs, timeout=120):
+    outs = [eng.submit(r)[1] for r in reqs]
+    ids, reasons = [], []
+    for out in outs:
+        toks = []
+        while True:
+            o = out.get(timeout=timeout)
+            if o.token_id >= 0:
+                toks.append(o.token_id)
+            if o.finished:
+                ids.append(toks)
+                reasons.append(o.finish_reason)
+                break
+    return ids, reasons
+
+
+def _req(prompt, n, *, seed=0, temp=0.8, policy=""):
+    from localai_tpu.engine.engine import GenRequest
+    from localai_tpu.ops.sampling import SamplingParams
+
+    return GenRequest(list(prompt), SamplingParams(temperature=temp,
+                                                   seed=seed),
+                      max_tokens=n, ignore_eos=True, kv_policy=policy)
+
+
+@pytest.mark.slow
+def test_tier_parity_exact_when_retention_covers_context(tiny_parts):
+    """sinks+window >= context: nothing ever leaves retention, so the
+    tiered engine's token streams are EXACTLY the full-KV ones (ring
+    write map + masked tiered attention are semantically invisible)."""
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(1, 96, n).tolist() for n in (37, 120, 64)]
+    reqs = lambda: [_req(p, 24, seed=10 + i, temp=0.8)  # noqa: E731
+                    for i, p in enumerate(prompts)]
+    ec = dict(max_slots=3, max_context=512, prefill_buckets=(32,),
+              decode_block=4)
+    full = _engine(tiny_parts, kv_pages=16, **ec)
+    full.start()
+    try:
+        ref, rr = _drive(full, reqs())
+    finally:
+        full.stop()
+    tier = _engine(tiny_parts, kv_pages=32,
+                   kv_policy="sink_window(sinks=256, window=256)", **ec)
+    tier.start()
+    try:
+        got, gr = _drive(tier, reqs())
+    finally:
+        tier.stop()
+    assert gr == rr == ["length"] * 3
+    assert got == ref
+
+
+@pytest.mark.slow
+def test_eviction_prefix_cache_refcount_interaction(tiny_parts):
+    """Windowed admissions may borrow ONLY whole sink blocks from a shared
+    prefix (ring columns hold rotated content no other tenant can address);
+    the excess shared blocks are unref'd — never corrupted — and the
+    recompute metric records the re-prefilled blocks. The full-policy
+    tenant's retained prefix survives the windowed tenant's lifecycle."""
+    rng = np.random.default_rng(5)
+    prefix = rng.integers(1, 96, 4 * BLOCK).tolist()
+    ec = dict(max_slots=2, max_context=2048, prefill_buckets=(32,),
+              decode_block=4, prompt_cache_min=8)
+    eng = _engine(tiny_parts, kv_pages=40,
+                  kv_policy="sink_window(sinks=128, window=256)", **ec)
+    eng.start()
+    try:
+        # full-policy tenant seeds the prefix cache (retained at release)
+        ref, _ = _drive(eng, [_req(prefix + [7, 8], 8, seed=1, temp=0.0,
+                                   policy="full")])
+        hits0 = eng.metrics["prompt_cache_hits"]
+        # windowed tenant shares the prefix: borrows sink blocks only
+        _drive(eng, [_req(prefix + [9, 10], 8, seed=2)])
+        assert eng.metrics["prompt_cache_hits"] > hits0
+        # 4 shared prefix blocks, sink_blocks=1 -> 3 blocks re-prefilled
+        assert eng.metrics["kv_recomputes"] == 3
+        # the retained full-policy prefix is intact: same prompt, same
+        # greedy tokens as the cold first run
+        again, _ = _drive(eng, [_req(prefix + [7, 8], 8, seed=1, temp=0.0,
+                                     policy="full")])
+        assert again == ref
+        # pool accounting closed: a block is in the free list iff its
+        # refcount is zero — no leak, no double-free, no corrupted share
+        free = set(eng._kv_free)
+        assert len(free) == len(eng._kv_free)
+        for pb in range(1, eng.ec.kv_pages):
+            assert (pb in free) == (eng._block_ref[pb] == 0), pb
+    finally:
+        eng.stop()
+
+
+@pytest.mark.slow
+@pytest.mark.tripwire
+def test_tier_tripwires_mixed_hot_cold_stream(tiny_parts):
+    """Compile-once + dispatch-budget on a mixed hot/cold stream: full and
+    windowed requests interleaved on a quantize_cold engine, demotions
+    firing mid-stream. The per-slot tier map is runtime data — a second
+    mixed stream compiles NOTHING new — and demote copies ride their own
+    program without spending decode dispatches."""
+    from localai_tpu.testing.tripwires import (
+        CompileCounter, decode_cache_sizes, decode_compile_count,
+        dispatch_budget,
+    )
+
+    rng = np.random.default_rng(7)
+    ec = dict(max_slots=2, max_context=2048, prefill_buckets=(32,),
+              decode_block=4, prompt_cache=False)
+    eng = _engine(tiny_parts, kv_pages=40, kv_cold_pages=24,
+                  kv_policy="sink_window(sinks=128, window=128, "
+                            "quantize_cold=true)", **ec)
+    eng.start()
+    try:
+        def stream(seed):
+            r = np.random.default_rng(seed)
+            return [
+                _req(r.integers(1, 96, 20).tolist(), 400, seed=seed),
+                _req(r.integers(1, 96, 33).tolist(), 8, seed=seed + 1,
+                     policy="full"),
+                _req(r.integers(1, 96, 150).tolist(), 300, seed=seed + 2,
+                     policy="sink_window(sinks=128, window=128)"),
+            ]
+
+        _, reasons = _drive(eng, stream(11))
+        assert reasons == ["length"] * 3
+        assert eng.metrics["kv_cold_blocks"] > 0, eng.metrics
+        warm = decode_compile_count(eng)
+        with CompileCounter() as cc:
+            with dispatch_budget(eng, max_per_128_tokens=3.0):
+                _, reasons = _drive(eng, stream(23))
+        assert reasons == ["length"] * 3
+        assert cc.total == 0, cc.counts
+        assert decode_compile_count(eng) == warm, decode_cache_sizes(eng)
+        # demote copies are not decode dispatches
+        assert eng.metrics["kv_cold_blocks"] > 0
+    finally:
+        eng.stop()
+
+
+@pytest.mark.slow
+def test_longctx_32k_quantize_cold_parity(tiny_parts):
+    """ctx-32k decode parity vs full KV within the tier's stated tolerance:
+    under quantize_cold every position stays readable (sinks + window at
+    full precision, the exited middle at sub-channel int8), so greedy
+    token agreement is bounded by int8 quantization error only — the
+    documented tolerance (README Long-context tier)."""
+    rng = np.random.default_rng(9)
+    n = 32 * 1024
+    prompt = rng.integers(1, 96, n).tolist()
+    decode = 32
+    ctx = n + decode + 2 * BLOCK
+    ec = dict(max_slots=1, max_context=ctx, prefill_buckets=(128, 512),
+              prefill_chunk=512, decode_block=8)
+    full = _engine(tiny_parts, kv_pages=blocks_needed(ctx) + 2, **ec)
+    full.start()
+    try:
+        # the 32k full-KV prefill is minutes of CPU work before the first
+        # token lands — give the stream a generous first-chunk timeout
+        (ref,), _ = _drive(full, [_req(prompt, decode, temp=0.0)],
+                           timeout=900)
+    finally:
+        full.stop()
+    cold = _engine(tiny_parts, kv_pages=64,
+                   kv_cold_pages=blocks_needed(ctx) + 2,
+                   kv_policy="sink_window(sinks=256, window=1024, "
+                             "quantize_cold=true)", **ec)
+    cold.start()
+    try:
+        (got,), _ = _drive(cold, [_req(prompt, decode, temp=0.0)],
+                           timeout=900)
+        m = dict(cold.metrics)
+    finally:
+        cold.stop()
+    assert m["kv_cold_blocks"] > 200, m       # the middle really demoted
+    assert m["kv_blocks_peak"] <= 63, m       # pool bounded, not O(ctx)
+    agree = sum(a == b for a, b in zip(got, ref)) / max(len(ref), 1)
+    assert agree >= 0.75, (agree, got, ref)
