@@ -76,6 +76,30 @@ def dispatch_stats(metrics: dict) -> dict:
     }
 
 
+def slo_stats(metrics: dict) -> dict:
+    """SLO scoreboard fields (ttft_p95_ms / tpot_p50_ms / queue_wait_p50_ms)
+    rebuilt from the engine's own streaming histograms (`hist_*` GetMetrics
+    keys or the in-process registry's flat() map) — engine-measured, not a
+    host stopwatch around the RPC (ISSUE 11)."""
+    try:
+        from localai_tpu.telemetry import parse_flat, snapshot_from_hists
+
+        snap = snapshot_from_hists(parse_flat(metrics))
+    except Exception:
+        return {}
+    out = {}
+    ttft = snap.get("ttft") or {}
+    tpot = snap.get("tpot") or {}
+    qw = snap.get("queue_wait") or {}
+    if ttft.get("count"):
+        out["ttft_p95_ms"] = round(ttft["p95_ms"], 3)
+    if tpot.get("count"):
+        out["tpot_p50_ms"] = round(tpot["p50_ms"], 4)
+    if qw.get("count"):
+        out["queue_wait_p50_ms"] = round(qw["p50_ms"], 4)
+    return out
+
+
 # ---------------------------------------------------------- run artifacts
 # The scoreboard contract (ROADMAP open item #1 / VERDICT round-5 ask #1):
 # BENCH_rN.json must never print `device: cpu` while a real on-chip artifact
@@ -135,14 +159,19 @@ def latest_tpu_artifact(runs_dir: str = "") -> tuple[dict, str] | None:
     return (best[1], best[2]) if best else None
 
 
-def emit_stale_artifact(art: dict, path: str, probe_error: str) -> None:
+def emit_stale_artifact(art: dict, path: str, probe_error: str,
+                        probe_report: dict | None = None) -> None:
     """Print the archived on-chip result as THE scoreboard line, flagged
-    stale — never a CPU number when a real TPU artifact exists."""
+    stale — never a CPU number when a real TPU artifact exists. The probe
+    report rides along so a stale line still says exactly WHERE this run's
+    chip init wedged (phase + thread stacks)."""
     out = dict(art)
     out["stale"] = True
     out["stale_source"] = os.path.basename(path)
     if probe_error:
         out["probe_error"] = probe_error[:500]
+    if probe_report is not None:
+        out["probe_report"] = probe_report
     note(f"TPU unreachable — surfacing stale on-chip artifact "
          f"{out['stale_source']} (recorded {out.get('recorded_at', '?')})")
     print(json.dumps(out))
@@ -205,64 +234,181 @@ def peak_flops_per_chip(kind: str) -> float:
     return 197e12
 
 
+# the debuggable chip probe (ISSUE 11): init broken into named phases, each
+# announced on stdout the moment it STARTS, so a wedged init says exactly
+# where it wedged (plugin handshake vs client init vs first transfer vs
+# first compile). faulthandler arms a watchdog that dumps EVERY thread's
+# stack to stderr and exits just before the parent's timeout — the stacks
+# land in the probe report instead of dying with the child.
+PROBE_PHASES = ("plugin_handshake", "client_init", "first_device_put",
+                "first_compile")
+
+_PROBE_CHILD = r"""
+import faulthandler, sys, time
+t0 = time.time()
+
+def phase(name):
+    print(f"PROBE_PHASE {name} {time.time()-t0:.1f}s", flush=True)
+
+faulthandler.dump_traceback_later(float(sys.argv[1]), exit=True)
+phase("plugin_handshake")   # importing jax registers the PJRT plugin
+import jax
+phase("client_init")        # first jax.devices() builds the PJRT client
+d = jax.devices()[0]
+phase("first_device_put")   # first host->device transfer
+import numpy as np
+x = jax.device_put(np.ones((8,), np.float32))
+jax.block_until_ready(x)
+phase("first_compile")      # first XLA compile + execute
+jax.block_until_ready(jax.jit(lambda a: a * 2.0)(x))
+faulthandler.cancel_dump_traceback_later()
+print("PROBE_OK", d.platform, getattr(d, "device_kind", ""),
+      f"{time.time()-t0:.0f}s", flush=True)
+"""
+
+
+def _run_probe_once(timeout_s: int, compile_cache: str) -> dict:
+    """One probe child under a heartbeat: stdout is read incrementally so
+    phase transitions surface live on stderr, and the attempt record keeps
+    the phase timings plus the faulthandler stack dump on a hang."""
+    import subprocess
+
+    env = dict(os.environ)
+    if compile_cache:
+        # persistent XLA compilation cache: a warm cache turns the
+        # first_compile phase from minutes into seconds on repeat runs
+        env["JAX_COMPILATION_CACHE_DIR"] = compile_cache
+    # the child's own watchdog fires before the parent timeout so the stack
+    # dump reaches stderr while the pipe is still alive
+    child_limit = max(10, timeout_s - 5)
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _PROBE_CHILD, str(child_limit)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
+    phases: dict[str, float] = {}
+    ok_lines: list[str] = []
+    stderr_buf: list[str] = []
+
+    def _stdout_reader():
+        for line in proc.stdout:
+            line = line.strip()
+            if line.startswith("PROBE_PHASE"):
+                parts = line.split()
+                if len(parts) >= 3:
+                    try:
+                        phases[parts[1]] = float(parts[2].rstrip("s"))
+                    except ValueError:
+                        phases[parts[1]] = -1.0
+                    note(f"probe phase: {parts[1]} (+{parts[2]})")
+            elif line.startswith("PROBE_OK"):
+                ok_lines.append(line)
+
+    def _stderr_reader():
+        stderr_buf.append(proc.stderr.read() or "")
+
+    readers = [threading.Thread(target=_stdout_reader, daemon=True),
+               threading.Thread(target=_stderr_reader, daemon=True)]
+    [t.start() for t in readers]
+    timed_out = False
+    try:
+        rc = proc.wait(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        timed_out = True
+        proc.kill()
+        rc = proc.wait()
+    [t.join(timeout=5) for t in readers]
+    stderr = stderr_buf[0] if stderr_buf else ""
+    # the faulthandler watchdog exits rc=1 after printing "Timeout (...)!"
+    # plus every thread's stack — that IS a timeout, not a crash
+    timed_out = timed_out or "Timeout (" in stderr
+    done = [p for p in PROBE_PHASES if p in phases]
+    attempt = {
+        "timeout_s": timeout_s,
+        "rc": rc,
+        "timed_out": timed_out,
+        "ok": bool(ok_lines),
+        "phases_s": phases,
+        "last_phase": done[-1] if done else "",
+    }
+    if ok_lines:
+        parts = ok_lines[-1].split()
+        attempt["platform"] = parts[1]
+        attempt["device_kind"] = " ".join(parts[2:-1]) or parts[1]
+        attempt["init_s"] = phases.get("first_compile", 0.0)
+    else:
+        # not ok: the last announced phase is the one it died/stuck in
+        attempt["stuck_phase"] = done[-1] if done else "spawn"
+        attempt["stack_dump"] = stderr[-4000:]
+    return attempt
+
+
 def probe_accelerator(args) -> tuple[bool, str, str]:
     """Probe accelerator init in a subprocess: a dead TPU tunnel hangs
     jax.devices() forever, and a hung bench records nothing. The parent must
     NEVER init JAX itself in serve mode — it would hold the chip and starve
     the backend subprocess — so the probe also reports the device kind.
-    Returns (use_cpu, probe_error, device_kind)."""
+    Returns (use_cpu, probe_error, device_kind); the full phased report
+    (per-attempt phase timings + stack dumps) lands on args.probe_report and
+    is embedded in every result artifact."""
+    report: dict = {
+        "attempts": [],
+        "ok": False,
+        "single_attempt": bool(getattr(args, "probe_single_attempt", False)),
+        "compile_cache": getattr(args, "probe_compile_cache", "") or "",
+        "phases": list(PROBE_PHASES),
+    }
+    args.probe_report = report
     if args.cpu:
+        report["ok"] = True
+        report["device"] = "cpu"
         return True, "", "cpu"
-    import subprocess
 
-    total = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", "900"))
-    # a flaky tunnel can hang one client-creation attempt and accept the
-    # next — split the budget into escalating attempts (the last one long
-    # enough for a legitimately slow cold init)
-    ladder = [max(60, int(total * f)) for f in (0.25, 0.25, 0.5)]
-    code = ("import time,jax; t=time.time(); d=jax.devices()[0]; "
-            "print('PROBE_OK', d.platform, getattr(d,'device_kind',''), "
-            "f'{time.time()-t:.0f}s', flush=True)")
+    total = (getattr(args, "probe_timeout", 0)
+             or int(os.environ.get("BENCH_PROBE_TIMEOUT_S", "900")))
+    if report["single_attempt"]:
+        # one long attempt: a legitimately slow cold init (big compile, cold
+        # plugin) gets the whole budget instead of dying on ladder rungs
+        ladder = [max(60, total)]
+    else:
+        # a flaky tunnel can hang one client-creation attempt and accept the
+        # next — split the budget into escalating attempts (the last one
+        # long enough for a legitimately slow cold init)
+        ladder = [max(60, int(total * f)) for f in (0.25, 0.25, 0.5)]
     err = ""
     hard_fails = 0
-    for attempt, probe_timeout in enumerate(ladder, 1):
-        note(f"probing accelerator (attempt {attempt}/{len(ladder)}, "
+    for attempt_n, probe_timeout in enumerate(ladder, 1):
+        note(f"probing accelerator (attempt {attempt_n}/{len(ladder)}, "
              f"{probe_timeout}s limit)...")
-        try:
-            probe = subprocess.run([sys.executable, "-c", code],
-                                   capture_output=True, text=True,
-                                   timeout=probe_timeout)
-            ok = [l for l in (probe.stdout or "").splitlines()
-                  if l.startswith("PROBE_OK")]
-            if probe.returncode != 0 or not ok:
-                tail = (probe.stderr or "").strip().splitlines()[-8:]
-                err = f"rc={probe.returncode}: " + " | ".join(tail)
-                note(f"probe FAILED — {err}")
-                # fast non-timeout failures are usually deterministic
-                # (missing libtpu etc.) — one retry covers the transient
-                # connection-refused case, then stop burning the budget
-                hard_fails += 1
-                if hard_fails >= 2:
-                    break
-                continue
-            note(f"probe ok: {ok[-1]}")
-            platform = ok[-1].split()[1]
-            kind = " ".join(ok[-1].split()[2:-1]) or platform
-            if platform == "cpu":
+        a = _run_probe_once(probe_timeout, report["compile_cache"])
+        report["attempts"].append(a)
+        if a["ok"]:
+            note(f"probe ok: {a['device_kind']} in {a.get('init_s', 0):.0f}s")
+            report["ok"] = True
+            report["device"] = a["device_kind"]
+            if a["platform"] == "cpu":
                 # a TPU-less machine: run the CPU smoke, never publish it as
                 # a comparable per-chip number
                 note("probe found only CPU — results will be non-comparable")
                 return True, "", "cpu"
-            return False, "", kind
-        except subprocess.TimeoutExpired as e:
-            tail = ""
-            for s in (e.stderr, e.stdout):
-                if s:
-                    s = s if isinstance(s, str) else s.decode(errors="replace")
-                    tail += " | ".join(s.strip().splitlines()[-4:])
-            err = f"init timed out after {probe_timeout}s: {tail}"
+            return False, "", a["device_kind"]
+        if a["timed_out"]:
+            err = (f"init timed out after {probe_timeout}s in phase "
+                   f"{a['stuck_phase']} (reached: "
+                   f"{', '.join(a['phases_s']) or 'none'}); thread stacks "
+                   f"in probe_report")
             note(f"probe TIMED OUT — {err}")
+        else:
+            tail = " | ".join(
+                (a.get("stack_dump") or "").strip().splitlines()[-8:])
+            err = f"rc={a['rc']} in phase {a['stuck_phase']}: {tail}"
+            note(f"probe FAILED — {err}")
+            # fast non-timeout failures are usually deterministic
+            # (missing libtpu etc.) — one retry covers the transient
+            # connection-refused case, then stop burning the budget
+            hard_fails += 1
+            if hard_fails >= 2:
+                break
     note("falling back to CPU (results will be non-comparable)")
+    report["error"] = err
     return True, err, "cpu"
 
 
@@ -388,6 +534,7 @@ def bench_serve(args, size: str, on_cpu: bool):
         stats = {}
         try:
             m = handle.client.metrics()
+            args.slo_metrics = m   # hist_* keys → emit_result's slo_stats
             stats = dispatch_stats(m)
             d, s = m.get("decode_dispatches", 0), m.get(
                 "decode_steps_dispatched", 0)
@@ -1055,12 +1202,45 @@ def build_parser() -> argparse.ArgumentParser:
                    help="emit the CPU smoke number even when an archived "
                         "on-chip artifact exists (default: surface the "
                         "stale TPU artifact instead)")
+    p.add_argument("--probe-timeout", type=int, default=0,
+                   help="accelerator probe budget in seconds (0 = "
+                        "$BENCH_PROBE_TIMEOUT_S or 900); split into an "
+                        "escalating attempt ladder unless "
+                        "--probe-single-attempt")
+    p.add_argument("--probe-single-attempt", action="store_true",
+                   help="one probe attempt spanning the whole timeout "
+                        "budget — for a legitimately slow cold init the "
+                        "ladder would kill mid-compile")
+    p.add_argument("--probe-compile-cache", default="",
+                   help="persistent XLA compilation cache dir "
+                        "(JAX_COMPILATION_CACHE_DIR) for the probe child "
+                        "AND the benched process — a warm cache turns a "
+                        "multi-minute first_compile phase into seconds")
     return p
 
 
 def emit_result(result: dict, args) -> int:
-    """Final scoreboard emission: fold in the --trace stage breakdown, write
-    the Chrome-trace dump, archive on-chip artifacts, print the JSON line."""
+    """Final scoreboard emission: fold in the --trace stage breakdown, the
+    probe phase report, and the engine-histogram SLO fields; write the
+    Chrome-trace dump, archive on-chip artifacts, print the JSON line."""
+    report = getattr(args, "probe_report", None)
+    if report is not None:
+        result.setdefault("probe_report", report)
+    # engine-sourced latency percentiles: serve mode captured the backend's
+    # hist_* GetMetrics keys; in-process modes read the live registry.
+    # setdefault — modes publishing their own under-load stopwatch numbers
+    # (ragged) keep them.
+    src = getattr(args, "slo_metrics", None)
+    if src is None:
+        try:
+            from localai_tpu import telemetry
+
+            slo = telemetry.maybe_slo()
+            src = slo.flat() if slo is not None else {}
+        except Exception:
+            src = {}
+    for k, v in slo_stats(src).items():
+        result.setdefault(k, v)
     payload = getattr(args, "trace_payload", None)
     if payload is not None:
         profile = payload.get("profile") or {}
@@ -1098,6 +1278,10 @@ def emit_result(result: dict, args) -> int:
 
 def main(argv=None):
     args = build_parser().parse_args(argv)
+    if args.probe_compile_cache:
+        # the benched process (backend subprocess or in-process jax) shares
+        # the probe's persistent compilation cache
+        os.environ["JAX_COMPILATION_CACHE_DIR"] = args.probe_compile_cache
     if args.trace:
         # env, not in-process flags: serve mode's backend subprocess must
         # inherit them (manager spawn copies os.environ)
@@ -1110,7 +1294,8 @@ def main(argv=None):
         # archived on-chip artifact (flagged stale), never a CPU number
         found = latest_tpu_artifact(args.runs_dir or "")
         if found is not None:
-            emit_stale_artifact(found[0], found[1], probe_error)
+            emit_stale_artifact(found[0], found[1], probe_error,
+                                getattr(args, "probe_report", None))
             return 0
     size = args.size or ("tiny" if on_cpu else "8b")
     if args.slots is None:

@@ -276,6 +276,13 @@ class LLMServicer(BackendServicer):
             logging.getLogger("localai_tpu").warning(
                 "prewarm failed; first request will pay compiles",
                 exc_info=True)
+        finally:
+            # the synthetic warm requests must not pollute the serving SLO
+            # percentiles (warmup() snapshots the dispatch counters the same
+            # way)
+            slo = telemetry.maybe_slo()
+            if slo is not None:
+                slo.reset()
 
     def _load_bert(self, request, model_dir: str):
         """Embedding-only load path for BERT-family encoders: generation RPCs
@@ -462,6 +469,7 @@ class LLMServicer(BackendServicer):
             logprobs=logprobs if request.logprobs else [],
             token_ids=ids,
             finish_reason=o.finish_reason or "",
+            timings_json=json.dumps(o.timings) if o.timings else "",
         )
 
     def PredictStream(self, request, context):
@@ -502,6 +510,8 @@ class LLMServicer(BackendServicer):
                     if request.logprobs and o.token_id >= 0 else [],
                     token_ids=[o.token_id] if o.token_id >= 0 else [],
                     finish_reason=o.finish_reason or "",
+                    timings_json=(json.dumps(o.timings)
+                                  if o.finished and o.timings else ""),
                 )
                 if o.finished:
                     return
@@ -586,14 +596,26 @@ class LLMServicer(BackendServicer):
             # flattened stage profile (prof_<stage>_{count,total_ms,p50_ms,
             # tok_s}) rides the existing str→double metrics surface
             m.update(self.engine._prof.flat())
+        slo = telemetry.maybe_slo()
+        if slo is not None:
+            # SLO histograms (hist_<metric>__<path>__{bN,count,sum} +
+            # ttft_ms_p50/p95) ride the same surface; the HTTP layer rebuilds
+            # true Prometheus histogram series from these at scrape time
+            m.update(slo.flat())
         return pb.MetricsResponse(metrics={k: float(v) for k, v in m.items()})
 
     def GetTrace(self, request, context):
+        slo = telemetry.maybe_slo()
         payload = {
             "spans": telemetry.chrome_events(),
             "profile": (self.engine._prof.report()
                         if self.engine is not None
                         and self.engine._prof is not None else {}),
+            # SLO percentile snapshot + flight-recorder dump (ISSUE 11):
+            # the /debug/slo and /debug/flightrec lanes across the process
+            # boundary, reusing the JSON-in-Reply transport
+            "slo": slo.snapshot() if slo is not None else {},
+            "flightrec": telemetry.flightrec().dump(),
             "pid": os.getpid(),
             "model": self.model_name,
         }

@@ -250,20 +250,25 @@ def _add_worker(sub):
 def _add_util(sub):
     p = sub.add_parser("util",
                        help="model utilities (reference: core/cli util cmd)")
-    p.add_argument("action", choices=["hf-info", "fits", "trace"],
+    p.add_argument("action", choices=["hf-info", "fits", "trace",
+                                      "flightrec"],
                    help="hf-info: checkpoint geometry + params; "
                             "fits: HBM fit estimate; "
                             "trace: pull a Chrome-trace + stage profile "
-                            "from a running server's /debug endpoints")
+                            "from a running server's /debug endpoints; "
+                            "flightrec: dump the server's flight recorder "
+                            "(recent request timelines + SLO percentiles)")
     p.add_argument("model", help="checkpoint directory (hf-info/fits) or "
-                                 "server address (trace)")
+                                 "server address (trace/flightrec)")
     p.add_argument("--slots", type=int, default=8)
     p.add_argument("--context", type=int, default=2048)
     p.add_argument("--dtype", default="bfloat16")
     p.add_argument("--cache-type", default="")
     p.add_argument("--hbm-gb", type=float, default=None)
-    p.add_argument("--out", default="trace.json",
-                   help="trace: output Chrome-trace file")
+    p.add_argument("--out", default="",
+                   help="trace: output Chrome-trace file "
+                        "(default trace.json); "
+                        "flightrec: output dump file (default stdout)")
     p.add_argument("--api-key", default="",
                    help="trace: bearer token for a key-protected server")
     return p
@@ -287,10 +292,11 @@ def cli_util_trace(args) -> int:
             return _json.loads(r.read().decode())
 
     trace = fetch("/debug/trace")
-    with open(args.out, "w") as fh:
+    out = args.out or "trace.json"
+    with open(out, "w") as fh:
         _json.dump(trace, fh)
     n = len(trace.get("traceEvents", []))
-    print(f"{args.out}: {n} events")
+    print(f"{out}: {n} events")
     profile = fetch("/debug/profile")
     for model, prof in (profile.get("models") or {}).items():
         stages = (prof or {}).get("stages") or {}
@@ -313,11 +319,56 @@ def cli_util_trace(args) -> int:
     return 0
 
 
+def cli_util_flightrec(args) -> int:
+    """`local-ai util flightrec <addr>` — pull /debug/flightrec +
+    /debug/slo from a running server: recent request timelines, engine
+    ticks, tripwire/breaker/supervision events, and the current latency
+    percentiles. JSON goes to --out (or stdout); a summary to stderr."""
+    import json as _json
+    import sys as _sys
+    import urllib.request
+
+    base = args.model if args.model.startswith("http") \
+        else f"http://{args.model}"
+
+    def fetch(path):
+        req = urllib.request.Request(base + path)
+        if args.api_key:
+            req.add_header("Authorization", f"Bearer {args.api_key}")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return _json.loads(r.read().decode())
+
+    dump = fetch("/debug/flightrec")
+    slo = fetch("/debug/slo")
+    payload = {"flightrec": dump, "slo": slo}
+    if args.out:
+        with open(args.out, "w") as fh:
+            _json.dump(payload, fh, indent=1)
+        print(f"wrote {args.out}")
+    else:
+        print(_json.dumps(payload, indent=1))
+    for model, rec in (dump.get("models") or {}).items():
+        reqs = (rec or {}).get("requests") or []
+        events = (rec or {}).get("events") or []
+        print(f"{model}: {len(reqs)} recent requests, "
+              f"{len(events)} events in the ring", file=_sys.stderr)
+    for model, snap in (slo.get("models") or {}).items():
+        e2e = (snap or {}).get("e2e") or {}
+        if e2e.get("count"):
+            print(f"{model}: e2e p50 {e2e.get('p50_ms', 0):.0f} ms  "
+                  f"p95 {e2e.get('p95_ms', 0):.0f} ms  "
+                  f"p99 {e2e.get('p99_ms', 0):.0f} ms  "
+                  f"({e2e['count']} requests)", file=_sys.stderr)
+    return 0
+
+
 def cli_util(args) -> int:
     import json as _json
 
     if args.action == "trace":
         return cli_util_trace(args)
+    if args.action == "flightrec":
+        return cli_util_flightrec(args)
 
     from localai_tpu.engine.loader import load_config
     from localai_tpu.system.memory import estimate, param_count

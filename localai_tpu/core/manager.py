@@ -117,7 +117,8 @@ class ModelManager:
             if br is None:
                 br = self._breakers[name] = CircuitBreaker(
                     threshold=getattr(self.app, "breaker_threshold", 3),
-                    cooldown=getattr(self.app, "breaker_cooldown", 15.0))
+                    cooldown=getattr(self.app, "breaker_cooldown", 15.0),
+                    name=name)
             return br
 
     # ------------------------------------------------------------ spawn/load
@@ -315,9 +316,20 @@ class ModelManager:
         with self._lock:
             return sorted(self._models)
 
+    # reap reasons that are routine lifecycle, not failures — they go in the
+    # flight-recorder ring but do not trigger a post-mortem dump
+    _GRACEFUL_REAPS = ("stopped by request", "drained for shutdown",
+                      "server shutdown", "single_active_backend")
+
     def _reap(self, h: BackendHandle, reason: str = ""):
         """Remove (if current) + terminate one backend. Safe to call from any
         thread; never holds the map lock across the process wait."""
+        from localai_tpu import telemetry
+
+        rec = telemetry.flightrec()
+        rec.record_event("backend_reaped", model=h.name, reason=reason)
+        if not reason.startswith(self._GRACEFUL_REAPS):
+            rec.auto_dump(f"backend_reaped:{h.name}")
         with self._lock:
             if self._models.get(h.name) is h:
                 del self._models[h.name]

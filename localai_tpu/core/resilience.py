@@ -83,9 +83,10 @@ class CircuitBreaker:
     CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
 
     def __init__(self, threshold: int = 3, cooldown: float = 15.0,
-                 clock=time.monotonic):
+                 clock=time.monotonic, name: str = ""):
         self.threshold = max(1, int(threshold))
         self.cooldown = float(cooldown)
+        self.name = name            # flight-recorder label ("" = anonymous)
         self._clock = clock
         self._lock = threading.Lock()
         self._state = self.CLOSED
@@ -118,10 +119,24 @@ class CircuitBreaker:
     def record_failure(self):
         with self._lock:
             self._failures += 1
-            if self._state == self.HALF_OPEN or \
-                    self._failures >= self.threshold:
+            failures = self._failures
+            trip = (self._state == self.HALF_OPEN
+                    or failures >= self.threshold)
+            opened = trip and self._state != self.OPEN
+            if trip:
                 self._state = self.OPEN
                 self._opened_at = self._clock()
+        if opened:
+            # post-mortem hook (ISSUE 11): a breaker transitioning to OPEN
+            # means a backend is failing repeatedly — snapshot the recent
+            # request/event history while it is still in the ring. Outside
+            # the lock: auto_dump does file I/O.
+            from localai_tpu import telemetry
+
+            rec = telemetry.flightrec()
+            rec.record_event("breaker_open", name=self.name,
+                             failures=failures)
+            rec.auto_dump(f"breaker_open:{self.name or 'anon'}")
 
 
 def backoff(attempt: int, base: float = 0.25, cap: float = 2.0) -> float:

@@ -54,6 +54,7 @@ from localai_tpu.ops.sampling import (
     sampler_row,
 )
 from localai_tpu.parallel.mesh import activate_mesh
+from localai_tpu.testing import faults
 
 
 @dataclasses.dataclass(frozen=True)
@@ -183,6 +184,10 @@ class GenRequest:
     # injected into prefill instead of token embeddings
     mm_embeds: Any = None          # np.ndarray [K, H] | None
     mm_positions: Any = None       # np.ndarray [K] i64 | None
+    queued_t: float = 0.0          # time.monotonic() at submit() — the
+                                   # arrival instant the SLO layer measures
+                                   # queue wait and TTFT from (0 = direct
+                                   # construction, falls back to admission)
 
 
 @dataclasses.dataclass
@@ -196,6 +201,10 @@ class StepOutput:
     finish_reason: str | None = None   # stop | length | eos
     generated_tokens: int = 0
     prompt_tokens: int = 0
+    timings: dict | None = None        # per-request phase timeline, attached
+                                       # to the FINAL chunk only (ISSUE 11;
+                                       # None mid-stream or with the SLO
+                                       # layer disabled)
 
 
 @dataclasses.dataclass
@@ -229,6 +238,21 @@ class _Slot:
                                      # dispatch's per-slot `remaining` net of
                                      # this, so a slot can never overshoot
                                      # max_tokens however dispatches overlap
+    # SLO phase timeline (ISSUE 11) — maintained only when the registry is
+    # enabled (engine._slo is not None); all zeros/None otherwise
+    prefill_done_t: float | None = None  # last prompt chunk committed
+    last_token_t: float | None = None    # host arrival of the latest token
+                                         # batch (TPOT reference point)
+    obs_tokens: int = 0              # generated count at last_token_t — the
+                                     # fused loop delivers token BURSTS, so
+                                     # TPOT is the amortized gap over the
+                                     # burst, weighted by its token count
+    path: str = ""                   # decode path that served the latest
+                                     # token (loop/dense/ragged/spec)
+    dispatches: int = 0              # device dispatches this request rode
+                                     # (Kernel Looping's per-request number)
+    timeline: dict | None = None     # finished-request record handed to the
+                                     # flight recorder at release
 
 
 class _AsyncFetch:
@@ -480,6 +504,13 @@ class Engine:
 
         self._prof = telemetry.engine_profiler(cfg, mesh=self.mesh)
         self._tracer = telemetry.maybe_tracer()
+        # serving SLO layer (ISSUE 11): streaming histograms + the flight
+        # recorder, same one-attribute-load-and-branch contract as _obs when
+        # disabled (LOCALAI_METRICS=0 → both None)
+        self._slo = telemetry.maybe_slo()
+        self._flightrec = (telemetry.flightrec()
+                           if self._slo is not None else None)
+        self._tick_n = 0
 
         # runtime tripwire (localai_tpu/testing/tripwires): with
         # LOCALAI_TRANSFER_GUARD set, every decode dispatch runs under
@@ -1462,6 +1493,7 @@ class Engine:
             self._next_id += 1
             self._live.add(rid)
         out: queue.Queue = queue.Queue()
+        req.queued_t = time.monotonic()
         self._queue.put((rid, req, out))
         self._wake.set()
         return rid, out
@@ -1688,6 +1720,18 @@ class Engine:
             prefilled=not chunked, row=row, counts_row=counts_row,
             prefill_pos=lcp, disk_prefix=disk_prefix, fast_w=fast_w,
         )
+        slo = self._slo
+        if slo is not None:
+            if req.queued_t:
+                slo.observe("queue_wait", "all",
+                            slot_obj.start_time - req.queued_t)
+            if not chunked:
+                # single-shot prefill: committed within this admission (the
+                # dispatch itself is async — host-side prefill time is the
+                # admission work, real chunked time lands in _prefill_drain)
+                slot_obj.prefill_done_t = time.monotonic()
+                slo.observe("prefill", "all",
+                            slot_obj.prefill_done_t - slot_obj.start_time)
         if self._tracer is not None:
             # one span per request, admission → release; request_id ties it
             # to the HTTP/gRPC spans of the same request, trace_parent nests
@@ -1709,7 +1753,8 @@ class Engine:
             # spec invariant: the first token is sampled (and emitted) at
             # admission; it becomes the carried next_token
             tok, lp = self._dev_spec_admit_tail(slot)
-            self._emit(slot, slot_obj, tok, lp, time.monotonic())
+            self._emit(slot, slot_obj, tok, lp, time.monotonic(),
+                       path="spec")
         return True
 
     def _prefill_tick(self):
@@ -1762,9 +1807,15 @@ class Engine:
                 if final:
                     slot.prefilled = True
                     self._prefillq.remove(idx)
+                    if self._slo is not None:
+                        slot.prefill_done_t = time.monotonic()
+                        self._slo.observe(
+                            "prefill", "all",
+                            slot.prefill_done_t - slot.start_time)
                     if self._draft is not None:
                         tok, lp = self._dev_spec_admit_tail(idx)
-                        self._emit(idx, slot, tok, lp, time.monotonic())
+                        self._emit(idx, slot, tok, lp, time.monotonic(),
+                                   path="spec")
                 continue
             if not self._free:
                 return
@@ -2027,6 +2078,11 @@ class Engine:
         self.metrics["decode_steps_dispatched"] += steps
         self._release_reservations(entries, res)
         now = time.monotonic()
+        if self._slo is not None:
+            for i, rid in entries:
+                s = self._slots[i]
+                if s is not None and s.request_id == rid:
+                    s.dispatches += 1
         emitted = 0
         for g in range(steps):
             for i, rid in entries:
@@ -2036,7 +2092,7 @@ class Engine:
                 if slot is None or slot.request_id != rid:
                     continue  # finished earlier (cancel/deadline/shift race)
                 self._emit(i, slot, int(tokens[g, i]),
-                           float(logprobs[g, i]), now)
+                           float(logprobs[g, i]), now, path="loop")
                 emitted += 1
         self._obs("sample", t0, tokens=emitted, steps=steps, rollbacks=0)
         self._dispatch_gauges()
@@ -2060,6 +2116,11 @@ class Engine:
         if tokens.ndim == 1:
             tokens, logprobs = tokens[None], logprobs[None]
         steps = tokens.shape[0]
+        if self._slo is not None:
+            for i, rid in entries:
+                s = self._slots[i]
+                if s is not None and s.request_id == rid:
+                    s.dispatches += 1
         rolled: list[int] = []
         for g in range(steps):
             for i, rid in entries:
@@ -2143,12 +2204,14 @@ class Engine:
                     continue
                 self.metrics["draft_proposed"] += G
                 self.metrics["draft_accepted"] += int(n_extra[i])
+                if self._slo is not None:
+                    slot.dispatches += 1
                 for j in range(int(n_out[i])):
                     slot = self._slots[i]
                     if slot is None or slot.request_id != rid:
                         break  # finished mid-window (EOS/length/stop)
                     self._emit(i, slot, int(tokens_out[i, j]),
-                               float(logprobs_out[i, j]), now)
+                               float(logprobs_out[i, j]), now, path="spec")
         else:
             self._prefill_tick()
         return (any(s is not None for s in self._slots)
@@ -2280,16 +2343,33 @@ class Engine:
                 self._dev_install(idx, s.row, s.counts_row)
                 s.prefilled = True
                 self._prefillq.remove(idx)
+                if self._slo is not None:
+                    s.prefill_done_t = time.monotonic()
+                    self._slo.observe("prefill", "all",
+                                      s.prefill_done_t - s.start_time)
         t0 = time.perf_counter()
         tokens_out, logprobs = fetch.wait()
         self.metrics["host_sync_wait_ms"] += (time.perf_counter() - t0) * 1e3
         now = time.monotonic()
+        if self._slo is not None:
+            # dispatch attribution: every slot packed into this ragged tick
+            # (decode rows AND prefill chunks) rode one device dispatch
+            for i, rid in entries:
+                s = self._slots[i]
+                if s is not None and s.request_id == rid:
+                    s.dispatches += 1
+            for idx, _pos, _nv, _fin in chunks:
+                s = self._slots[idx]
+                if s is not None:
+                    s.dispatches += 1
+                    s.path = "ragged"
         emitted = 0
         for i, rid in entries:
             s = self._slots[i]
             if s is None or s.request_id != rid:
                 continue
-            self._emit(i, s, int(tokens_out[i]), float(logprobs[i]), now)
+            self._emit(i, s, int(tokens_out[i]), float(logprobs[i]), now,
+                       path="ragged")
             emitted += 1
         self._obs("sample", t0, tokens=emitted, steps=1, rollbacks=0)
         self._dispatch_gauges()
@@ -2343,6 +2423,23 @@ class Engine:
         Python bookkeeping behind the next step's compute. Grammar-constrained
         batches run synchronously (the sampled token must update the PDA mask
         before the next sample). Returns True while work remains."""
+        if faults.fire("engine_crash") is not None:
+            # chaos hook (LOCALAI_FAULT=engine_crash): a deterministic fatal
+            # step — drives the _loop restart + flight-recorder post-mortem
+            # path in tests; one env dict miss when disarmed
+            raise RuntimeError("injected engine_crash (LOCALAI_FAULT)")
+        if self._flightrec is not None:
+            self._tick_n += 1
+            if (self._tick_n & 63) == 0:
+                self._flightrec.record_tick({
+                    "tick": self._tick_n,
+                    "t_wall": time.time(),
+                    "active_slots": sum(s is not None for s in self._slots),
+                    "queued": self._queue.qsize(),
+                    "deferred": self._deferred is not None,
+                    "tokens_generated": self.metrics["tokens_generated"],
+                    "decode_dispatches": self.metrics["decode_dispatches"],
+                })
         if self._draft is not None:
             return self._step_spec()
         if self._tiered:
@@ -2374,7 +2471,8 @@ class Engine:
                 or self._deferred is not None)
 
     def _emit(self, idx: int, slot: _Slot, token_id: int, logprob: float,
-              now: float, fresh_mask: bool = True) -> bool:
+              now: float, fresh_mask: bool = True,
+              path: str = "dense") -> bool:
         """Commit one sampled token to `slot` (grammar advance, detok, stop
         scan, stream, maybe finish). Returns False — with NO state mutated —
         when the slot's grammar rejects a token sampled under a STALE fused-
@@ -2442,6 +2540,26 @@ class Engine:
         slot.generated += 1
         slot.gen_ids.append(token_id)
         self.metrics["tokens_generated"] += 1
+        slo = self._slo
+        if slo is not None:
+            slot.path = path
+            if slot.last_token_t is None:
+                # TTFT from ARRIVAL (queued_t), the user-perceived number;
+                # ttft_ms_last above keeps its admission-relative meaning
+                slo.observe("ttft", path,
+                            now - (slot.req.queued_t or slot.start_time))
+                slot.last_token_t = now
+                slot.obs_tokens = slot.generated
+            elif now > slot.last_token_t:
+                # amortized inter-token gap: a fused-loop dispatch delivers a
+                # burst sharing one host arrival — weight the gap over the
+                # burst instead of recording zeros inside it
+                k = slot.generated - slot.obs_tokens
+                if k > 0:
+                    slo.observe("tpot", path,
+                                (now - slot.last_token_t) / k, n=k)
+                slot.last_token_t = now
+                slot.obs_tokens = slot.generated
         if shift:
             self._dev_shift(idx)
             slot.shifted += self._shift_discard
@@ -2475,10 +2593,17 @@ class Engine:
                 emit_text = slot.pending_text[:stable] if stable > 0 else ""
                 slot.pending_text = slot.pending_text[max(stable, 0):]
 
+        timings = None
+        if finish is not None and slo is not None:
+            timings = self._timeline(slot, finish, now)
+            slot.timeline = timings   # _release_slot → flight recorder
+            slo.observe("e2e", slot.path or path,
+                        now - (slot.req.queued_t or slot.start_time))
         slot.out.put(StepOutput(
             request_id=slot.request_id, text=emit_text, token_id=token_id,
             logprob=logprob, finished=finish is not None, finish_reason=finish,
             generated_tokens=slot.generated, prompt_tokens=slot.prompt_len,
+            timings=timings,
         ))
         if finish is not None:
             dur = now - slot.start_time
@@ -2487,6 +2612,27 @@ class Engine:
             self.metrics["requests_completed"] += 1
             self._release_slot(idx, slot)
         return True
+
+    def _timeline(self, slot: _Slot, reason: str, now: float) -> dict:
+        """The request's phase timeline (ms, arrival-relative) — the final
+        StepOutput's `timings` payload and the flight-recorder record."""
+        qt = slot.req.queued_t or slot.start_time
+        return {
+            "request_id": slot.req.trace_id or f"rid-{slot.request_id}",
+            "path": slot.path or "dense",
+            "finish_reason": reason,
+            "prompt_tokens": slot.prompt_len,
+            "generated_tokens": slot.generated,
+            "dispatches": slot.dispatches,
+            "kv_policy": slot.req.kv_policy or self.ec.kv_policy or "full",
+            "queue_wait_ms": (slot.start_time - qt) * 1e3,
+            "prefill_ms": ((slot.prefill_done_t - slot.start_time) * 1e3
+                           if slot.prefill_done_t is not None else None),
+            "ttft_ms": ((slot.first_token_time - qt) * 1e3
+                        if slot.first_token_time is not None else None),
+            "e2e_ms": (now - qt) * 1e3,
+            "t_wall_finished": time.time(),
+        }
 
     # --------------------------------------------- paged-KV block allocator
     # Host-side, reservation-based: a request reserves every block it could
@@ -2812,6 +2958,8 @@ class Engine:
 
     def _release_slot(self, idx: int, slot: _Slot):
         self._finish_rid(slot.request_id)
+        if self._flightrec is not None and slot.timeline is not None:
+            self._flightrec.record_request(slot.timeline)
         if slot.span is not None and self._tracer is not None:
             ttft_ms = ((slot.first_token_time - slot.start_time) * 1e3
                        if slot.first_token_time is not None else None)
@@ -3013,10 +3161,18 @@ class Engine:
         for i, slot in enumerate(self._slots):
             if slot is None:
                 continue
+            timings = None
+            if self._slo is not None:
+                # the dying request's timeline reaches the flight recorder
+                # (via _release_slot) and its terminal chunk — the black-box
+                # record the post-mortem dump is for
+                timings = self._timeline(slot, reason, time.monotonic())
+                slot.timeline = timings
             slot.out.put(StepOutput(
                 request_id=slot.request_id, text="", token_id=-1, logprob=0.0,
                 finished=True, finish_reason=reason,
                 generated_tokens=slot.generated, prompt_tokens=slot.prompt_len,
+                timings=timings,
             ))
             self._release_slot(i, slot)
         while True:
@@ -3034,11 +3190,20 @@ class Engine:
         while self._running:
             try:
                 busy = self.step()
-            except Exception:  # device OOM, compile failure, ...
+            except Exception as e:  # device OOM, compile failure, ...
                 import traceback
 
                 traceback.print_exc()
                 self._fail_active("error")
+                # black box first (rare path — always recorded, dump capped):
+                # the ring now holds every failed request's timeline
+                from localai_tpu.telemetry import flightrec
+
+                rec = flightrec()
+                rec.record_event("engine_fatal",
+                                 error=f"{type(e).__name__}: {e}",
+                                 restarts=restarts)
+                rec.auto_dump("engine_fatal")
                 if restarts >= self.ec.max_restarts:
                     self._running = False
                     self._dead = True

@@ -39,7 +39,8 @@ class Worker:
         self.healthy = True
         self.last_check = 0.0
         self.breaker = CircuitBreaker(threshold=breaker_threshold,
-                                      cooldown=breaker_cooldown)
+                                      cooldown=breaker_cooldown,
+                                      name=f"worker:{self.url}")
 
 
 class FederatedServer:

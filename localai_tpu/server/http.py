@@ -31,21 +31,29 @@ from localai_tpu.server import schema
 
 try:
     from prometheus_client import (
-        CONTENT_TYPE_LATEST, Counter, Gauge, Histogram, generate_latest,
+        CONTENT_TYPE_LATEST, Counter, Gauge, Histogram, REGISTRY,
+        generate_latest,
     )
+    from prometheus_client.core import HistogramMetricFamily
 
     _API_CALLS = Counter("localai_api_calls_total", "API calls",
                          ["path", "status"])
     _API_LATENCY = Histogram("localai_api_latency_seconds", "API latency",
                              ["path"])
     # engine-stage series (telemetry subsystem): refreshed from each loaded
-    # backend's GetMetrics prof_* keys at scrape time (LOCALAI_PROFILE runs)
-    _STAGE_SECONDS = Gauge(
+    # backend's GetMetrics prof_* keys at scrape time (LOCALAI_PROFILE
+    # runs). These are cumulative, so they are COUNTERS (ISSUE 11 satellite:
+    # they were Gauges despite the _total suffix); prometheus_client strips
+    # and re-appends the suffix, so the exposed series names are unchanged.
+    # Scrape-side .set() semantics are recovered by inc-ing the delta
+    # against the last scraped value (_counter_sync).
+    _STAGE_SECONDS = Counter(
         "localai_engine_stage_seconds_total",
         "Cumulative fenced time per engine stage", ["model", "stage"])
-    _STAGE_DISPATCHES = Gauge(
+    _STAGE_DISPATCHES = Counter(
         "localai_engine_stage_dispatches_total",
         "Cumulative dispatch count per engine stage", ["model", "stage"])
+    # tokens/s is a last-value rate — legitimately a Gauge
     _STAGE_TOK_S = Gauge(
         "localai_engine_stage_tokens_per_second",
         "Tokens/s through each engine stage", ["model", "stage"])
@@ -55,9 +63,59 @@ try:
                     "Requests shed by admission control or drain",
                     ["model", "reason"])
     # backend supervision events (spawn retries, respawns, watchdog reaps,
-    # breaker rejections) — refreshed from ModelManager.events at scrape
-    _SUPERVISION = Gauge("localai_backend_supervision_total",
-                         "Backend supervision events", ["model", "event"])
+    # breaker rejections) — refreshed from ModelManager.events at scrape;
+    # cumulative event counts → Counter (was a mis-typed Gauge)
+    _SUPERVISION = Counter("localai_backend_supervision_total",
+                           "Backend supervision events", ["model", "event"])
+    # last cumulative value each counter child was synced to, keyed by the
+    # label tuple — a backend restart resets its counters, which _counter_sync
+    # treats as a fresh start (standard Prometheus counter-reset semantics)
+    _COUNTER_LAST: dict = {}
+
+    def _counter_sync(counter, labels: tuple, value: float):
+        """Bring a scrape-fed Counter child to an absolute cumulative value
+        by inc-ing the delta (Counter has no .set, by design)."""
+        key = (counter, labels)
+        last = _COUNTER_LAST.get(key, 0.0)
+        if value < last:     # source restarted: its series began again
+            last = 0.0
+        if value > last:
+            counter.labels(*labels).inc(value - last)
+            _COUNTER_LAST[key] = value
+        elif key not in _COUNTER_LAST:
+            counter.labels(*labels)   # materialize the child at 0
+            _COUNTER_LAST[key] = value
+
+    # latest per-model SLO histograms, refreshed at scrape from each
+    # backend's GetMetrics hist_* keys (telemetry.metrics.parse_flat);
+    # exposed as TRUE Prometheus histogram series by _SLOCollector
+    _SLO_SCRAPE: dict = {}
+
+    class _SLOCollector:
+        """Custom collector rebuilding localai_request_<metric>_seconds
+        histogram series (_bucket/_sum/_count, labels model+path) from the
+        scraped engine histograms — prometheus_client's Histogram cannot be
+        set to absolute bucket counts, a raw MetricFamily can."""
+
+        def collect(self):
+            fams = {}
+            for model, hists in list(_SLO_SCRAPE.items()):
+                for (metric, path), h in hists.items():
+                    fam = fams.get(metric)
+                    if fam is None:
+                        fam = fams[metric] = HistogramMetricFamily(
+                            f"localai_request_{metric}_seconds",
+                            f"Per-request {metric} latency",
+                            labels=["model", "path"])
+                    acc, buckets = 0, []
+                    for i, ub in enumerate(telemetry.BUCKETS_S):
+                        acc += h.counts[i]
+                        le = "+Inf" if ub == float("inf") else repr(ub)
+                        buckets.append((le, acc))
+                    fam.add_metric([model, path], buckets, h.sum)
+            return list(fams.values())
+
+    REGISTRY.register(_SLOCollector())
     _HAVE_PROM = True
 except Exception:  # pragma: no cover - prometheus_client is in the image
     _HAVE_PROM = False
@@ -72,6 +130,20 @@ _SAMPLING_FIELDS = (
 
 
 _IMAGE_FETCH_LIMIT = 16 << 20   # 16 MiB of image bytes per URL
+
+
+def _engine_timings(reply) -> dict:
+    """The engine's per-request phase timeline (Reply.timings_json, set on
+    the FINAL reply only) → the llama.cpp-style `timings` block: queued→
+    admitted→first_token→finished ms, decode path, dispatch count."""
+    raw = getattr(reply, "timings_json", "")
+    if not raw:
+        return {}
+    try:
+        t = json.loads(raw)
+    except ValueError:
+        return {}
+    return t if isinstance(t, dict) else {}
 
 
 def _fetch_image(url: str) -> str:
@@ -160,6 +232,11 @@ class API:
         # stage profile across the HTTP process and every backend subprocess
         r.add_get("/debug/trace", self._debug_trace)
         r.add_get("/debug/profile", self._debug_profile)
+        # SLO observability (ISSUE 11): percentile snapshot per model+path
+        # and the crash flight recorder (recent request timelines, engine
+        # ticks, tripwire/breaker/supervision events)
+        r.add_get("/debug/slo", self._debug_slo)
+        r.add_get("/debug/flightrec", self._debug_flightrec)
         r.add_get("/backend/monitor", self._backend_monitor)
         r.add_post("/backend/shutdown", self._backend_shutdown)
         r.add_get("/system", self._system)
@@ -548,11 +625,11 @@ class API:
                             content_type=CONTENT_TYPE_LATEST.split(";")[0])
 
     def _refresh_stage_gauges(self):
-        """Pull each loaded backend's prof_* metrics into the Prometheus
-        stage gauges (best-effort — a wedged backend must not fail the
+        """Pull each loaded backend's prof_* + hist_* metrics into the
+        Prometheus series (best-effort — a wedged backend must not fail the
         scrape, and profile-less runs simply publish nothing)."""
         for (model, event), n in list(self.manager.events.items()):
-            _SUPERVISION.labels(model, event).set(n)
+            _counter_sync(_SUPERVISION, (model, event), float(n))
         for name in self.manager.loaded():
             h = self.manager.get(name)
             if h is None:
@@ -561,16 +638,22 @@ class API:
                 m = h.client.metrics(timeout=2.0)
             except Exception:
                 continue
+            # SLO histograms: rebuilt whole from the flat keys; the custom
+            # collector exposes them as true histogram series
+            hists = telemetry.parse_flat(m)
+            if hists:
+                _SLO_SCRAPE[name] = hists
             for key, v in m.items():
                 if not key.startswith("prof_"):
                     continue
                 stage, _, kind = key[5:].rpartition("_")
                 if kind == "count":
-                    _STAGE_DISPATCHES.labels(name, stage).set(v)
+                    _counter_sync(_STAGE_DISPATCHES, (name, stage), float(v))
                 elif kind == "s" and stage.endswith("_tok"):
                     _STAGE_TOK_S.labels(name, stage[:-4]).set(v)
                 elif kind == "ms" and stage.endswith("_total"):
-                    _STAGE_SECONDS.labels(name, stage[:-6]).set(v / 1e3)
+                    _counter_sync(_STAGE_SECONDS, (name, stage[:-6]),
+                                  v / 1e3)
 
     async def _backend_traces(self, model: str = "") -> list[dict]:
         """GetTrace payloads from the loaded backends ({} on any failure)."""
@@ -619,6 +702,35 @@ class API:
             "tracing_enabled": telemetry.trace_enabled(),
             "profiling_enabled": telemetry.profile_enabled(),
             "models": profiles,
+        })
+
+    async def _debug_slo(self, request):
+        """GET /debug/slo[?model=x] → per-model p50/p95/p99 snapshot of the
+        serving SLO histograms (ttft/tpot/queue_wait/prefill/e2e, split by
+        decode path), straight from each backend engine's registry. Empty
+        per-model blocks when LOCALAI_METRICS=0."""
+        models = {}
+        for payload in await self._backend_traces(
+                request.query.get("model", "")):
+            models[payload["model"]] = payload.get("slo") or {}
+        return web.json_response({
+            "metrics_enabled": telemetry.metrics_enabled(),
+            "bucket_edges_s": [b for b in telemetry.BUCKETS_S
+                               if b != float("inf")],
+            "models": models,
+        })
+
+    async def _debug_flightrec(self, request):
+        """GET /debug/flightrec[?model=x] → the flight recorder rings: this
+        process's events plus each backend's recent request timelines,
+        engine-tick summaries, and tripwire/breaker/supervision events."""
+        models = {}
+        for payload in await self._backend_traces(
+                request.query.get("model", "")):
+            models[payload["model"]] = payload.get("flightrec") or {}
+        return web.json_response({
+            "server": telemetry.flightrec().dump(),
+            "models": models,
         })
 
     async def _models(self, request):
@@ -709,13 +821,15 @@ class API:
                 tool_calls, answer = parse_tool_response(text)
                 if answer is not None:
                     text = answer
+            timings = {
+                "prompt_processing_s": reply.timing_prompt_processing,
+                "token_generation_s": reply.timing_token_generation,
+            }
+            timings.update(_engine_timings(reply))
             resp = schema.chat_completion(
                 cfg.name, text,
                 reply.finish_reason, reply.prompt_tokens, reply.tokens,
-                timings={
-                    "prompt_processing_s": reply.timing_prompt_processing,
-                    "token_generation_s": reply.timing_token_generation,
-                },
+                timings=timings,
                 tool_calls=tool_calls)
             schema.merge_extra_usage(
                 resp, bool(request.headers.get("Extra-Usage")),
@@ -762,12 +876,14 @@ class API:
         t_prompt = t_gen = 0.0
         finish = "stop"
         buffered: list[str] = []
+        timings: dict = {}
         try:
             async for reply in self._stream_rpc(cfg, opts):
                 prompt_tokens = reply.prompt_tokens
                 completion_tokens = reply.tokens
                 t_prompt = reply.timing_prompt_processing or t_prompt
                 t_gen = reply.timing_token_generation or t_gen
+                timings = _engine_timings(reply) or timings
                 text = reply.message.decode("utf-8", "replace")
                 if text:
                     if tools_active:
@@ -806,6 +922,9 @@ class API:
             schema.merge_extra_usage(
                 tail, bool(request.headers.get("Extra-Usage")),
                 t_prompt, t_gen)
+            if timings:
+                # llama.cpp-style per-request timings in the final chunk
+                tail["timings"] = timings
             await send(tail)
         await resp.write(b"data: [DONE]\n\n")
         await resp.write_eof()
@@ -848,6 +967,7 @@ class API:
         finish = "stop"
         prompt_tokens = completion_tokens = 0
         t_prompt = t_gen = 0.0
+        timings: dict = {}
 
         async def send(obj):
             await resp.write(f"data: {json.dumps(obj)}\n\n".encode())
@@ -859,6 +979,7 @@ class API:
                 completion_tokens = reply.tokens
                 t_prompt = reply.timing_prompt_processing or t_prompt
                 t_gen = reply.timing_token_generation or t_gen
+                timings = _engine_timings(reply) or timings
                 if reply.finish_reason:
                     finish = reply.finish_reason
                 if text:
@@ -869,6 +990,8 @@ class API:
         except Exception as e:
             return await self._sse_error(resp, send, e)
         final = schema.text_completion_chunk(rid, cfg.name, "", finish)
+        if timings:
+            final["timings"] = timings
         if request.headers.get("Extra-Usage"):
             # reference completion.go:74 parity on the stream too
             final["usage"] = schema.usage(prompt_tokens, completion_tokens)

@@ -13,8 +13,15 @@ never been decomposed):
 - exporters live with their surfaces: the HTTP server merges spans across
   processes via the backend GetTrace RPC.
 
+- `metrics` (ISSUE 11): the serving SLO layer — per-request phase-timeline
+  histograms (TTFT/TPOT/queue wait/prefill/e2e, labeled by decode path)
+  exported via GetMetrics `hist_*` keys, true Prometheus histogram series,
+  and `/debug/slo`; plus the crash/tripwire flight recorder
+  (`/debug/flightrec`, auto post-mortem dumps).
+
 Enable with `LOCALAI_TRACE=1` (spans) and `LOCALAI_PROFILE=1` (fenced stage
 timing). Both default off; the serving hot path is untouched when disabled.
+SLO metrics default ON (`LOCALAI_METRICS=0` disables).
 """
 from localai_tpu.telemetry.trace import (  # noqa: F401
     Tracer,
@@ -36,4 +43,17 @@ from localai_tpu.telemetry.profiler import (  # noqa: F401
     peak_flops,
     profile_enabled,
     set_profile_enabled,
+)
+from localai_tpu.telemetry.metrics import (  # noqa: F401
+    BUCKETS_S,
+    FlightRecorder,
+    Hist,
+    SLORegistry,
+    flightrec,
+    maybe_slo,
+    metrics_enabled,
+    parse_flat,
+    reset_flightrec,
+    set_metrics_enabled,
+    snapshot_from_hists,
 )

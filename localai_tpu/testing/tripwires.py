@@ -112,6 +112,15 @@ def dispatch_budget(engine, max_per_128_tokens: float = 3.0):
     tokens = m["tokens_generated"] - t0
     allowed = max(1, math.ceil(tokens / 128.0 * max_per_128_tokens))
     if dispatches > allowed:
+        # flight-recorder post-mortem (ISSUE 11): the request timelines in
+        # the ring at trip time show WHICH stream regressed to the ladder
+        from localai_tpu import telemetry
+
+        rec = telemetry.flightrec()
+        rec.record_event("tripwire", guard="dispatch_budget",
+                         dispatches=dispatches, tokens=tokens,
+                         allowed=allowed)
+        rec.auto_dump("tripwire:dispatch_budget")
         raise AssertionError(
             f"decode dispatch budget exceeded: {dispatches} dispatches for "
             f"{tokens} generated tokens (allowed {allowed} at "

@@ -250,6 +250,10 @@ def test_watchdog_reaps_idle(tmp_path, tmp_path_factory):
         while mgr.get("tiny") is not None and time.monotonic() < deadline:
             time.sleep(0.3)
         assert mgr.get("tiny") is None, "watchdog never reaped idle backend"
+        # the reaper drops the handle from the map BEFORE terminating the
+        # child (and waits up to 10s for it to die) — poll, don't race it
+        while h.alive() and time.monotonic() < deadline:
+            time.sleep(0.1)
         assert not h.alive()
     finally:
         mgr.stop_all()

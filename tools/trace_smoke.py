@@ -66,6 +66,7 @@ def main() -> int:
     [t.join() for t in threads]
 
     payload = handle.client.trace()
+    metrics = handle.client.metrics()
     manager.stop_all()
 
     events = list(payload.get("spans") or []) + telemetry.chrome_events()
@@ -93,6 +94,39 @@ def main() -> int:
     if not stages:
         print("FAIL: no stage profile recorded", file=sys.stderr)
         return 1
+
+    # SLO layer (ISSUE 11): the same scrape must carry the flat histogram
+    # keys + headline percentiles, and GetTrace the percentile snapshot and
+    # the flight-recorder rings with every smoke request's timeline
+    from localai_tpu.telemetry import parse_flat, snapshot_from_hists
+
+    if not any(k.startswith("hist_ttft__") for k in metrics):
+        print("FAIL: GetMetrics carries no hist_ttft__* keys", file=sys.stderr)
+        return 1
+    if not metrics.get("ttft_ms_p50", 0) > 0:
+        print("FAIL: no histogram-backed ttft_ms_p50", file=sys.stderr)
+        return 1
+    snap = snapshot_from_hists(parse_flat(metrics))
+    n = (snap.get("ttft") or {}).get("count", 0)
+    if n < args.requests:
+        print(f"FAIL: SLO snapshot counts {n} requests, "
+              f"expected >= {args.requests}", file=sys.stderr)
+        return 1
+    slo = payload.get("slo") or {}
+    if (slo.get("e2e") or {}).get("count", 0) < args.requests:
+        print(f"FAIL: GetTrace slo snapshot incomplete ({slo.keys()})",
+              file=sys.stderr)
+        return 1
+    rec = payload.get("flightrec") or {}
+    rec_ids = {r.get("request_id") for r in rec.get("requests") or []}
+    want_ids = {f"smoke-{i}" for i in range(args.requests)}
+    if not want_ids <= rec_ids:
+        print(f"FAIL: flight recorder missing request timelines "
+              f"({rec_ids})", file=sys.stderr)
+        return 1
+    print(f"SLO: ttft_p50={metrics['ttft_ms_p50']:.1f}ms "
+          f"ttft_p95={metrics.get('ttft_ms_p95', 0):.1f}ms "
+          f"flightrec={len(rec_ids)} timelines")
     print("trace smoke OK")
     return 0
 
