@@ -841,6 +841,189 @@ def bench_ragged(args, size: str, on_cpu: bool):
     return dense, ragged, equal, pages, budget, context, dtype
 
 
+# ---------------------------------------------------------------- soup mode
+
+SOUP_CORPUS = [
+    "the quick brown fox jumps over the lazy dog",
+    '{"a": 12, "b": "hello world"} {"a": 7, "b": "tokens"}',
+    "pack my box with five dozen liquor jugs",
+    '[1, 2, 3] {"key": "value", "n": 42} true false null',
+]
+
+SOUP_SCHEMA = {"type": "object",
+               "properties": {"a": {"type": "integer"},
+                              "b": {"type": "string"}},
+               "required": ["a", "b"]}
+
+
+def _soup_checkpoint(size: str, path: str) -> str:
+    """A synthetic checkpoint WITH a tokenizer: grammar compilation needs
+    real token texts, so train a small byte-level BPE in-process (the
+    `tokenizers` core dep — no torch) and clamp the config's vocab to it.
+    Soup numbers are self-relative (constrained vs plain on the SAME
+    geometry), so shrinking the vocab from the named size is fair."""
+    from tokenizers import Tokenizer, decoders, models, pre_tokenizers, \
+        trainers
+
+    write_synthetic_checkpoint(size, path)
+    tok = Tokenizer(models.BPE(unk_token=None))
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tok.decoder = decoders.ByteLevel()
+    trainer = trainers.BpeTrainer(
+        vocab_size=min(SIZES[size]["vocab_size"], 512) - 2,
+        special_tokens=["<s>", "</s>"],
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
+        show_progress=False)
+    tok.train_from_iterator(SOUP_CORPUS * 4, trainer=trainer)
+    tok.save(os.path.join(path, "tokenizer.json"))
+    with open(os.path.join(path, "tokenizer_config.json"), "w") as fh:
+        json.dump({"bos_token": "<s>", "eos_token": "</s>",
+                   "add_bos_token": True}, fh)
+    with open(os.path.join(path, "config.json")) as fh:
+        body = json.load(fh)
+    body["vocab_size"] = tok.get_vocab_size()
+    with open(os.path.join(path, "config.json"), "w") as fh:
+        json.dump(body, fh)
+    return path
+
+
+def bench_soup(args, size: str, on_cpu: bool):
+    """--mode soup: ONE draft+ragged+paged engine serving a mixed tenant
+    trace — grammar-constrained (device automaton tables), multimodal
+    (packed embedding injects), and plain streams, all speculative (the
+    engine drafts against itself). Two legs on the same warmed engine:
+
+      plain : every tenant unconstrained — the denominator,
+      soup  : tenants cycle plain / grammar / mm — the number the one-
+              program claim moves: constrained_over_plain >= ~0.8 means
+              constrained traffic rides the fast paths instead of dense
+              per-token fallbacks.
+
+    The measured soup windows run under the dispatch-budget tripwire and a
+    compile-count snapshot; dense_fallback_dispatches and per-tenant path
+    counts come from the engine's own accounting."""
+    import statistics as st
+
+    import jax
+    import numpy as np
+
+    from localai_tpu.engine import (
+        Engine, EngineConfig, GenRequest, Tokenizer, load_config,
+        load_params,
+    )
+    from localai_tpu.functions.grammars import json_schema_grammar
+    from localai_tpu.ops.paged import BLOCK
+    from localai_tpu.ops.sampling import SamplingParams
+    from localai_tpu.testing.tripwires import (
+        decode_compile_count, dispatch_budget,
+    )
+
+    tmp = tempfile.mkdtemp(prefix="bench-ckpt-")
+    ckpt = _soup_checkpoint(size, os.path.join(tmp, size))
+    os.environ["LOCALAI_ALLOW_SYNTHETIC"] = "1"
+    dtype = args.dtype or ("float32" if on_cpu else "bfloat16")
+    cfg = load_config(ckpt, dtype=dtype)
+    context = min(args.context, cfg.max_position)
+    params = load_params(ckpt, cfg, dtype=dtype)
+    jax.block_until_ready(params)
+    tok = Tokenizer.from_dir(ckpt)
+    note("params + tokenizer ready")
+
+    gamma = 3
+    tokens = min(args.prompt_len * 3 // 2 + args.decode_steps + gamma + 34,
+                 context)
+    pages = args.kv_pages or \
+        args.slots * (-(-tokens // BLOCK)) + args.slots + 1
+    budget = args.ragged_budget or args.slots * (gamma + 1) + 128
+    note(f"pool {pages} blocks, token budget {budget} rows, gamma {gamma}")
+
+    eng = Engine(cfg, params, tok, EngineConfig(
+        max_slots=args.slots, max_context=context,
+        prefill_buckets=(128, min(512, context)),
+        prefill_chunk=min(128, context),
+        kv_pages=pages, prompt_cache=False, gamma=gamma,
+        ragged_token_budget=budget), draft=(cfg, params))
+    eng.record_paths = True
+    grammar = json_schema_grammar(SOUP_SCHEMA)
+    embed = np.asarray(params["embed"], np.float32)
+    rng = np.random.default_rng(0)
+
+    def make_req(kind):
+        n = int(rng.integers(max(8, args.prompt_len // 2),
+                             args.prompt_len * 3 // 2 + 1))
+        ids = rng.integers(2, cfg.vocab_size, n).tolist()
+        sp = SamplingParams(temperature=0.8, top_k=40,
+                            seed=int(rng.integers(1 << 30)))
+        r = GenRequest(ids, sp, max_tokens=args.decode_steps,
+                       ignore_eos=(kind != "grammar"))
+        if kind == "grammar":
+            r.grammar = grammar
+        elif kind == "mm":
+            r.mm_embeds = embed[ids[1:5]] + 0.25
+            r.mm_positions = np.arange(1, 5)
+        return r
+
+    def burst(kinds):
+        # 2x oversubscription so freed slots backfill within the window
+        reqs = [(k, eng.submit(make_req(k))) for k in kinds * 2]
+        n0 = eng.metrics["tokens_generated"]
+        t0 = time.perf_counter()
+        while eng.step():
+            pass
+        dt = time.perf_counter() - t0
+        for kind, (rid, _) in reqs:
+            tenant_of[rid] = kind
+        return (eng.metrics["tokens_generated"] - n0) / dt
+
+    tenant_of: dict = {}
+    plain_kinds = ["plain"] * args.slots
+    soup_kinds = [("plain", "grammar", "mm")[i % 3]
+                  for i in range(args.slots)]
+
+    t0 = time.perf_counter()
+    eng.warmup()
+    burst(soup_kinds[: max(3, args.slots // 2)])  # program compiles
+    note(f"  programs compiled in {time.perf_counter() - t0:.1f}s")
+    warm_compiles = decode_compile_count(eng)
+    tenant_of.clear()
+    eng.req_path_counts.clear()
+
+    plain_tps = [burst(plain_kinds) for _ in range(args.windows)]
+    note(f"plain: {st.median(plain_tps):.1f} tok/s")
+    d0 = eng.metrics["decode_dispatches"]
+    r0 = eng.metrics["ragged_dispatches"]
+    with dispatch_budget(eng):
+        soup_tps = [burst(soup_kinds) for _ in range(args.windows)]
+    note(f"soup : {st.median(soup_tps):.1f} tok/s "
+         f"({st.median(soup_tps) / max(st.median(plain_tps), 1e-9):.2f}x "
+         f"plain)")
+
+    per_tenant: dict = {}
+    for rid, kind in tenant_of.items():
+        agg = per_tenant.setdefault(kind, {})
+        for path, cnt in eng.req_path_counts.get(rid, {}).items():
+            agg[path] = agg.get(path, 0) + cnt
+    dense_fallback = (eng.metrics["decode_dispatches"] - d0) \
+        - (eng.metrics["ragged_dispatches"] - r0)
+    result = {
+        "tok_s": st.median(soup_tps),
+        "plain_tok_s": st.median(plain_tps),
+        "per_tenant_paths": per_tenant,
+        "dense_fallback_dispatches": int(dense_fallback),
+        "compile_count_delta": decode_compile_count(eng) - warm_compiles,
+        "grammar_table_states": int(
+            eng.metrics.get("grammar_table_states", 0)),
+        "draft_acceptance": round(
+            eng.metrics.get("draft_accepted", 0)
+            / max(eng.metrics.get("draft_proposed", 1), 1), 4),
+        "metrics": dict(eng.metrics),
+    }
+    import shutil
+
+    shutil.rmtree(tmp, ignore_errors=True)
+    return result, pages, budget, context, dtype, gamma
+
+
 def _longctx_leg(args, cfg, params, *, max_context, kv_policy="",
                  kv_cold_pages=0, prompt_tokens, decode_steps,
                  greedy=False, seed=1):
@@ -1139,7 +1322,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="tiny|1b|3b|8b (default: 8b on TPU, tiny on CPU)")
     p.add_argument("--mode", default="serve",
                    choices=["serve", "engine", "embed", "whisper", "paged",
-                            "tp", "ragged", "longctx"],
+                            "tp", "ragged", "longctx", "soup"],
                    help="serve = gRPC backend subprocess (default); engine = "
                         "in-process; paged = dense AND paged in one process "
                         "with a paged_over_dense ratio; tp = single device "
@@ -1153,6 +1336,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "sink_window vs ctx-1k full KV with a "
                         "longctx_over_short ratio, bounded-pool peak, and "
                         "token-parity probes (BASELINE #2f); "
+                        "soup = mixed tenant trace (grammar + multimodal + "
+                        "speculative + plain) on ONE draft+ragged engine "
+                        "with a constrained_over_plain ratio, per-tenant "
+                        "dispatch-path counts, and a dense-fallback count "
+                        "(ISSUE 12); "
                         "embed/whisper = BASELINE configs #3/#4")
     p.add_argument("--embed-batch", type=int, default=256)
     p.add_argument("--dtype", default=None,
@@ -1461,6 +1649,41 @@ def main(argv=None):
             "device": device_kind,
             "params": n_params,
             **dispatch_stats(ragged["metrics"]),
+        }
+        if on_cpu and not args.cpu:
+            result["probe_error"] = probe_error[:500]
+        return emit_result(result, args)
+    if args.mode == "soup":
+        import jax
+
+        if on_cpu:
+            jax.config.update("jax_platforms", "cpu")
+        note("initializing device client...")
+        dev = jax.devices()[0]
+        device_kind = getattr(dev, "device_kind", dev.platform)
+        r, pages, budget, context, dtype, gamma = bench_soup(
+            args, size, on_cpu)
+        toks_per_s = r["tok_s"]
+        result = {
+            "metric": f"serve tok/s (llama-{size} {dtype}, mixed-tenant "
+                      f"soup on one draft+ragged engine, {args.slots} "
+                      f"slots, gamma {gamma}, budget {budget} rows, "
+                      f"ctx {context})",
+            "value": round(toks_per_s, 2),
+            "unit": "tok/s",
+            "vs_baseline": None,
+            "plain_tok_s": round(r["plain_tok_s"], 2),
+            "constrained_over_plain": round(
+                toks_per_s / max(r["plain_tok_s"], 1e-9), 4),
+            "per_tenant_paths": r["per_tenant_paths"],
+            "dense_fallback_dispatches": r["dense_fallback_dispatches"],
+            "compile_count_delta": r["compile_count_delta"],
+            "grammar_table_states": r["grammar_table_states"],
+            "draft_acceptance": r["draft_acceptance"],
+            "ragged_dispatches": int(
+                r["metrics"].get("ragged_dispatches", 0)),
+            "device": device_kind,
+            **dispatch_stats(r["metrics"]),
         }
         if on_cpu and not args.cpu:
             result["probe_error"] = probe_error[:500]

@@ -121,8 +121,23 @@ class EngineConfig:
                                   # decode ticks keep the fused while-loop
                                   # path. 0 disables (the default serving
                                   # paths are untouched). Rounded up to a
-                                  # QBLK (8-row) multiple; grammar slots and
-                                  # multimodal windows keep the dense paths.
+                                  # QBLK (8-row) multiple. Grammar slots ride
+                                  # the pack (fresh host masks each tick) and
+                                  # multimodal prompt chunks pack their
+                                  # feature rows via per-row embedding
+                                  # injection — neither forces a dense
+                                  # fallback dispatch.
+    grammar_table_states: int = 256  # device grammar tables: shared capacity
+                                  # (automaton states across live grammars)
+                                  # for the precompiled [S, ceil(V/32)] u32
+                                  # mask rows + [S, V] transition table that
+                                  # let constrained slots ride the fused
+                                  # while-loop and the spec verify window
+                                  # with the mask gathered ON DEVICE.
+                                  # Grammars whose reachable state set
+                                  # exceeds the cap (unbounded nesting) fall
+                                  # back to per-token host masks. 0 disables
+                                  # (every grammar slot is host-masked).
     kv_policy: str = "full"       # KV lifecycle tier (engine/kvtier.py):
                                   # "full" keeps every block hot (identical
                                   # to the untiered engine), "sink_window(
@@ -253,6 +268,16 @@ class _Slot:
                                      # (Kernel Looping's per-request number)
     timeline: dict | None = None     # finished-request record handed to the
                                      # flight recorder at release
+    gbase: int | None = None         # base row of this slot's grammar in the
+                                     # shared device mask/transition tables;
+                                     # None = host-masked (matcher walks the
+                                     # mask) because the automaton overflowed
+                                     # grammar_table_states or tables are off
+    path_counts: dict = dataclasses.field(default_factory=dict)
+                                     # per-path token counts for this request
+                                     # (exported via req_path_counts when
+                                     # engine.record_paths is set — bench
+                                     # soup's per-tenant dispatch attribution)
 
 
 class _AsyncFetch:
@@ -352,13 +377,16 @@ class Engine:
             if not self._paged:
                 raise ValueError(
                     "ragged_token_budget requires paged KV (set kv_pages)")
-            if self._draft is not None:
-                raise ValueError(
-                    "ragged continuous batching is incompatible with a "
-                    "draft model (speculation has its own fused program)")
             from localai_tpu.ops.pallas import QBLK
 
             rows = max(self.ec.ragged_token_budget, 2 * QBLK)
+            if self._draft is not None:
+                # spec-as-ragged: each verifying slot needs gamma+1 window
+                # rows (QBLK-aligned) in the flat stream — make sure a full
+                # slot population plus one prefill block always fits
+                winb = -(-(self.ec.gamma + 1) // QBLK)
+                rows = max(rows,
+                           (self.ec.max_slots * winb + 1) * QBLK)
             self._ragged_rows = -(-rows // QBLK) * QBLK
         # KV lifecycle tier (engine/kvtier.py): a windowed engine policy
         # switches the paged table to COMPACT geometry — the per-slot table
@@ -487,6 +515,10 @@ class Engine:
             # runs (bench.py --mode ragged reports it)
             self.metrics["ragged_dispatches"] = 0
             self.metrics["ragged_tokens_packed"] = 0
+        # per-request path attribution (bench.py --mode soup): opt-in so the
+        # dict can't grow unbounded under a long-lived server
+        self.record_paths = False
+        self.req_path_counts: dict[int, dict] = {}
         if self._tiered:
             # KV lifecycle telemetry: cold demotions, evictions (window-
             # exited blocks dropped — ring overwrite, or a full cold pool),
@@ -641,7 +673,35 @@ class Engine:
         self._mask_nbytes = (V + 7) // 8
         self._mask_host = np.full((B, self._mask_nbytes), 0xFF, np.uint8)
         self._grammar_slots = 0
+        self._grammar_hostonly = 0   # grammar slots WITHOUT device tables
+                                     # (automaton overflowed the cap): these
+                                     # keep the per-token host-mask paths and
+                                     # bar the fused while-loop
         self._grammar_cache = None
+        # device grammar tables (grammar_table_states > 0): ONE shared pair
+        # of arrays for every live grammar — masks [cap, ceil(V/32)] u32
+        # (LSB-first packed allowed-token rows) and trans [cap, V] i32
+        # (absolute next-state per token). Row 0 is the IDENTITY state every
+        # unconstrained slot sits in: all-ones mask (where(True, x, -inf) is
+        # x exactly, so constrained and unconstrained slots share one
+        # compiled program bit-identically) and a self-loop transition.
+        # Grammars get base offsets in _grammar_table_entry; the np mirrors
+        # are authoritative (host _emit advances _gstate through _gtrans_np)
+        # and the device copies refresh lazily on new installs (_gtab —
+        # same shapes, so no recompile).
+        self._mask_nwords = (V + 31) // 32
+        self._gtab_cap = max(int(self.ec.grammar_table_states), 0)
+        self._gstate = np.zeros((B,), np.int32)
+        if self._gtab_cap:
+            self._gmasks_np = np.zeros((self._gtab_cap, self._mask_nwords),
+                                       np.uint32)
+            self._gmasks_np[0] = 0xFFFFFFFF
+            self._gtrans_np = np.zeros((self._gtab_cap, V), np.int32)
+            self._gtab_used = 1
+            self._gtab_base: dict[str, int | None] = {}
+            self._gtab_dirty = True
+            self._gmasks_dev = None
+            self._gtrans_dev = None
 
         # host-side slot table
         self._slots: list[_Slot | None] = [None] * B
@@ -847,6 +907,31 @@ class Engine:
                 build_spec_admit_tail(cfg), donate_argnums=(0,))
             self._draft_ingest_fn = jax.jit(
                 build_draft_ingest(dcfg), donate_argnums=(3, 4))
+            # spec-as-ragged: the verify pass as a ragged pack variant —
+            # draft windows are just extra qlen rows in the flat stream,
+            # packed alongside other tenants' prefill chunks (and their
+            # multimodal inject rows) in ONE program (engine/spec.py
+            # build_spec_ragged). Replaces the per-mode dense verify on
+            # ragged engines; the extend-based _spec_fn stays for dense ones.
+            self._spec_ragged_fn = None
+            if self._ragged:
+                from localai_tpu.engine.spec import build_spec_ragged
+
+                _specr_raw = build_spec_ragged(cfg, dcfg, self.ec.gamma)
+
+                def _specr(*a, **kw):
+                    (tokens_out, n_out, logprobs_out, next_tokens, kct, vct,
+                     kcd, vcd, sampler, last_logits, lengths,
+                     n_extra) = _specr_raw(*a, **kw)
+                    return (constrain(tokens_out, P(None, None)),
+                            constrain(n_out, P(None)),
+                            constrain(logprobs_out, P(None, None)),
+                            constrain(next_tokens, P(None)),
+                            kct, vct, kcd, vcd, sampler, last_logits,
+                            lengths, constrain(n_extra, P(None)))
+
+                self._spec_ragged_fn = jax.jit(
+                    _specr, donate_argnums=(6, 7, 8, 9, 10, 11, 12, 13))
         self._decode_fn = jax.jit(_decode, donate_argnums=(3, 4, 5, 6, 7),
                                   static_argnames=())
         self._decode_nomask_fn = jax.jit(
@@ -940,15 +1025,22 @@ class Engine:
             def _ragged_step(params, cos, sin, kc, vc, sampler, last_logits,
                              lengths, tokens_flat, decode_slot, is_decode,
                              set_len, logit_set, logit_rows, block_seq,
-                             qstart, qlen, kvlen, table, kvt=None):
-                sampled, keys, logprobs = sample(last_logits, sampler, None,
-                                                 topk_width=None)
+                             qstart, qlen, kvlen, table, kvt=None,
+                             mask_bits=None, inject=None):
+                # mask_bits [B, ceil(V/8)] u8 rides ticks with grammar slots
+                # (the pack is consumed synchronously, so host masks are
+                # always fresh — this covers table AND overflow grammars);
+                # inject (extra [T, H] f32, is_embed [T] bool) carries
+                # multimodal feature rows for packed prompt chunks. Both are
+                # None on the common path — jit specializes each variant.
+                sampled, keys, logprobs = sample(last_logits, sampler,
+                                                 mask_bits, topk_width=None)
                 toks = jnp.where(decode_slot >= 0,
                                  sampled[jnp.maximum(decode_slot, 0)],
                                  tokens_flat)
                 logits, kc, vc = ragged_forward(
                     params, cfg, toks, cos, sin, kc, vc, block_seq, qstart,
-                    qlen, kvlen, table, logit_rows, kvt)
+                    qlen, kvlen, table, logit_rows, kvt, inject)
                 act = is_decode.astype(jnp.int32)
                 counts = sampler.token_counts.at[
                     jnp.arange(sampled.shape[0]), sampled].add(act)
@@ -1021,6 +1113,70 @@ class Engine:
             d["cold_k"], d["cold_v"] = self._ck, self._cv
             d["cold_tab"] = jnp.asarray(self._cold_table)
         return d
+
+    def _gtab(self):
+        """Device copies of the shared grammar tables (masks u32, trans
+        i32). Re-uploaded only after a new grammar install marked them
+        dirty — same shapes every time, so every consumer program compiles
+        exactly once and the upload is off the per-token hot path."""
+        if self._gtab_dirty:
+            with activate_mesh(self.mesh):
+                # lint: allow(host-sync-cast) — one-time table upload
+                self._gmasks_dev = jnp.asarray(self._gmasks_np)
+                self._gtrans_dev = jnp.asarray(self._gtrans_np)
+            self._gtab_dirty = False
+        return self._gmasks_dev, self._gtrans_dev
+
+    def _dev_gtable(self, base: int, masks, trans):
+        """Install one grammar's precompiled rows at `base` in the shared
+        table mirrors (device copies refresh lazily via _gtab). Broadcast so
+        follower ranks hold identical tables for the loop/spec replays."""
+        self._bcast("gtable", base=base, masks=masks, trans=trans)
+        n = masks.shape[0]
+        self._gmasks_np[base:base + n] = masks
+        self._gtrans_np[base:base + n] = trans
+        self._gtab_dirty = True
+
+    def _grammar_table_entry(self, grammar: str) -> int | None:
+        """Base offset of this grammar's rows in the shared device tables,
+        building + installing them (off the hot path) on first use. None =
+        the automaton doesn't fit (table overflow, or tables disabled) — the
+        slot then keeps the per-token host-mask paths."""
+        if not self._gtab_cap:
+            return None
+        if grammar in self._gtab_base:
+            return self._gtab_base[grammar]
+        cg = self._compile_grammar(grammar)
+        tbl = cg.table(self._gtab_cap)
+        base = None
+        if tbl is not None and self._gtab_used + tbl.n_states <= self._gtab_cap:
+            base = self._gtab_used
+            masks = tbl.masks.copy()
+            # local -1 (token masked off — never sampled) → absolute 0; the
+            # identity row is harmless if ever gathered. Live states remap
+            # to base-relative absolute indices.
+            trans = np.where(tbl.trans < 0, 0,
+                             tbl.trans + base).astype(np.int32)
+            # EOS policy is per-tokenizer, injected here (the raw table has
+            # no EOS bits — matcher.mask_bits parity): accepting states
+            # allow EOS and self-loop on it, mirroring the host matcher
+            # which never advances past EOS.
+            V = self.cfg.vocab_size
+            eos = [e for e in (self.tok.eos_ids if self.tok else ())
+                   if 0 <= e < V]
+            for s in range(tbl.n_states):
+                if tbl.accepting[s]:
+                    for e in eos:
+                        masks[s, e >> 5] |= np.uint32(1) << np.uint32(e & 31)
+                        trans[s, e] = base + s
+            self._dev_gtable(base, masks, trans)
+            self._gtab_used = base + tbl.n_states
+            self.metrics["grammar_table_states"] = self._gtab_used
+        else:
+            self.metrics["grammar_table_overflows"] = (
+                self.metrics.get("grammar_table_overflows", 0) + 1)
+        self._gtab_base[grammar] = base
+        return base
 
     def _note_pool(self):
         """Refresh the pool-occupancy gauges (tiered engines only — the
@@ -1202,32 +1358,45 @@ class Engine:
                   grammar=mask_host is not None)
         return _AsyncFetch((tokens, logprobs))
 
-    def _dev_decode_loop(self, active, remaining, check_eos, fast_width=None):
+    def _dev_decode_loop(self, active, remaining, check_eos, fast_width=None,
+                         gstate=None):
         """ONE while-loop dispatch covering up to ec.decode_loop decode steps
         with per-slot stop conditions on device (models/llama.py
         build_decode_loop). `remaining` [B] i32 is each slot's token budget
         for THIS dispatch (max_tokens net of in-flight reservations);
-        `check_eos` [B] bool gates the EOS-set stop. Steps actually run come
+        `check_eos` [B] bool gates the EOS-set stop. `gstate` [B] i32 (or
+        None) selects the grammar variant: each iteration gathers the
+        per-slot mask row from the shared device tables and advances the
+        automaton state on device, so table-backed grammar slots ride the
+        full loop with NO per-token host round trip (unconstrained slots sit
+        in identity row 0 — bit-identical sampling). Steps actually run come
         back with the async fetch — the dispatch-step metric is credited at
         consume time, when the early-exit count is known."""
         self.metrics["decode_dispatches"] += 1
         t0 = time.perf_counter()
         self._bcast("decode_loop", active=active, remaining=remaining,
-                    check_eos=check_eos, fast_width=fast_width)
+                    check_eos=check_eos, fast_width=fast_width,
+                    gstate=gstate)
         with activate_mesh(self.mesh), self._decode_guard():
+            gkw = {}
+            if gstate is not None:
+                gmasks, gtrans = self._gtab()
+                gkw = dict(gstate=jnp.asarray(np.asarray(gstate, np.int32)),
+                           gmasks=gmasks, gtrans=gtrans)
             (toks, lps, n_out, steps, self._kc, self._vc, self._sampler,
              self._last_logits, self._lengths) = self._decode_loop_fn(
                 self.params, self._cos, self._sin, self._kc, self._vc,
                 self._sampler, self._last_logits, self._lengths,
                 jnp.asarray(active), jnp.asarray(remaining),
                 jnp.asarray(check_eos), self._eos_dev, self._tab(),
-                fast_width=fast_width, kvt=self._kvt())
+                fast_width=fast_width, kvt=self._kvt(), **gkw)
         # tokens here is the RESERVED upper bound (actual count rides the
         # fetch); the consume-side "sample" stage records the exact number
         self._obs("decode_loop", t0,
                   tokens=int(np.minimum(np.maximum(remaining, 0),
                                         self.ec.decode_loop).sum()),
-                  fence=toks, fast_width=fast_width or 0)
+                  fence=toks, fast_width=fast_width or 0,
+                  grammar=gstate is not None)
         return _AsyncFetch((toks, lps, n_out, steps))
 
     def _dev_ragged(self, pack):
@@ -1244,8 +1413,10 @@ class Engine:
             self.metrics.get("ragged_tokens_packed", 0)
             + int(pack["packed"]))
         t0 = time.perf_counter()
-        self._bcast("ragged", **pack)
+        self._bcast("ragged", **dict(
+            pack, inject=self._inj_msg(pack.get("inject"))))
         with activate_mesh(self.mesh), self._decode_guard():
+            mask = pack.get("mask")
             (tokens, logprobs, self._kc, self._vc, self._sampler,
              self._last_logits, self._lengths) = self._ragged_fn(
                 self.params, self._cos, self._sin, self._kc, self._vc,
@@ -1258,9 +1429,59 @@ class Engine:
                 jnp.asarray(pack["logit_rows"]),
                 jnp.asarray(pack["block_seq"]),
                 jnp.asarray(pack["qstart"]), jnp.asarray(pack["qlen"]),
-                jnp.asarray(pack["kvlen"]), self._tab(), self._kvt())
-        self._obs("ragged", t0, tokens=int(pack["packed"]), fence=tokens)
+                jnp.asarray(pack["kvlen"]), self._tab(), self._kvt(),
+                None if mask is None else jnp.asarray(mask),
+                self._inj(pack.get("inject")))
+        self._obs("ragged", t0, tokens=int(pack["packed"]), fence=tokens,
+                  grammar=pack.get("mask") is not None)
         return _AsyncFetch((tokens, logprobs))
+
+    def _dev_spec_ragged(self, pack):
+        """ONE spec-as-ragged dispatch: gamma draft steps + a ragged target
+        verify covering every verifying slot's (gamma+1)-row window PLUS any
+        packed prefill chunks (and their multimodal inject rows) — the
+        one-program-for-every-tenant tick of a draft+ragged engine. Counted
+        as a ragged dispatch (exempt from the per-token dispatch budget the
+        same way, and for the same reason: it replaces N programs with 1)."""
+        self.metrics["decode_dispatches"] += 1
+        self.metrics["decode_steps_dispatched"] += self.ec.gamma + 1
+        self.metrics["ragged_dispatches"] = (
+            self.metrics.get("ragged_dispatches", 0) + 1)
+        self.metrics["ragged_tokens_packed"] = (
+            self.metrics.get("ragged_tokens_packed", 0)
+            + int(pack["packed"]))
+        t0 = time.perf_counter()
+        self._bcast("spec_ragged", **dict(
+            pack, inject=self._inj_msg(pack.get("inject"))))
+        with activate_mesh(self.mesh), self._decode_guard():
+            gkw = {}
+            gstate = pack.get("gstate")
+            if gstate is not None:
+                gmasks, gtrans = self._gtab()
+                gkw = dict(gstate=jnp.asarray(np.asarray(gstate, np.int32)),
+                           gmasks=gmasks, gtrans=gtrans)
+            (tokens_out, n_out, logprobs_out, self._next_tokens,
+             self._kc, self._vc, self._kcd, self._vcd, self._sampler,
+             self._last_logits, self._lengths,
+             n_extra) = self._spec_ragged_fn(
+                self.params, self._draft[1], self._cos, self._sin,
+                self._cos_d, self._sin_d, self._kc, self._vc,
+                self._kcd, self._vcd, self._sampler, self._last_logits,
+                self._lengths, self._next_tokens,
+                jnp.asarray(pack["verify"]),
+                jnp.asarray(pack["tokens"]),
+                jnp.asarray(pack["spec_rows"]),
+                jnp.asarray(pack["set_len"]),
+                jnp.asarray(pack["logit_set"]),
+                jnp.asarray(pack["logit_rows"]),
+                jnp.asarray(pack["block_seq"]),
+                jnp.asarray(pack["qstart"]), jnp.asarray(pack["qlen"]),
+                jnp.asarray(pack["kvlen"]), self._tab(),
+                kvt=self._kvt(), inject=self._inj(pack.get("inject")),
+                **gkw)
+        self._obs("spec_ragged", t0, tokens=int(pack["packed"]),
+                  fence=tokens_out, grammar=pack.get("gstate") is not None)
+        return _AsyncFetch((tokens_out, n_out, logprobs_out, n_extra))
 
     def _dev_demote(self, pb: int, ci: int):
         """Copy hot physical block `pb` into cold-pool index `ci` (int8,
@@ -1321,11 +1542,22 @@ class Engine:
                 self._draft[1], self._cos_d, self._sin_d, self._kcd,
                 self._vcd, jnp.asarray(buf), jnp.int32(pos), jnp.int32(idx))
 
-    def _dev_spec_admit_tail(self, idx):
-        self._bcast("spec_admit_tail", idx=idx)
+    def _dev_spec_admit_tail(self, idx, mask=None):
+        if mask is None:
+            s = self._slots[idx]
+            if s is not None and s.matcher is not None:
+                # grammar slot: the admission token samples under the start
+                # (or resumed) state's mask, same as every decode token
+                mask = self._mask_host[idx:idx + 1].copy()
+        self._bcast("spec_admit_tail", idx=idx, mask=mask)
         with activate_mesh(self.mesh):
-            tok, lp, self._sampler = self._spec_admit_tail_fn(
-                self._sampler, self._last_logits, jnp.int32(idx))
+            if mask is not None:
+                tok, lp, self._sampler = self._spec_admit_tail_fn(
+                    self._sampler, self._last_logits, jnp.int32(idx),
+                    jnp.asarray(mask))
+            else:
+                tok, lp, self._sampler = self._spec_admit_tail_fn(
+                    self._sampler, self._last_logits, jnp.int32(idx))
             self._next_tokens = self._next_tokens.at[idx].set(tok)
         # lint: allow(host-sync-cast) — spec invariant: the admission-sampled
         # first token must be emitted NOW (one sync per request, not per step)
@@ -1396,9 +1628,15 @@ class Engine:
                                    kw.get("fast_width"), kw.get("mask"))
         elif op == "decode_loop":
             self._dev_decode_loop(kw["active"], kw["remaining"],
-                                  kw["check_eos"], kw.get("fast_width"))
+                                  kw["check_eos"], kw.get("fast_width"),
+                                  kw.get("gstate"))
         elif op == "ragged":
-            self._dev_ragged(kw)
+            self._dev_ragged(dict(kw, inject=self._inj_of(kw.get("inject"))))
+        elif op == "spec_ragged":
+            self._dev_spec_ragged(
+                dict(kw, inject=self._inj_of(kw.get("inject"))))
+        elif op == "gtable":
+            self._dev_gtable(int(kw["base"]), kw["masks"], kw["trans"])
         elif op == "install":
             self._dev_install(kw["idx"], kw["row"], kw["counts_row"])
         elif op == "demote":
@@ -1408,7 +1646,7 @@ class Engine:
         elif op == "draft_ingest":
             self._dev_draft_ingest(kw["buf"], kw["pos"], kw["idx"])
         elif op == "spec_admit_tail":
-            self._dev_spec_admit_tail(kw["idx"])
+            self._dev_spec_admit_tail(kw["idx"], kw.get("mask"))
         elif op == "spec":
             self._dev_spec_decode(kw["active"])
         elif op == "reset":
@@ -1431,14 +1669,29 @@ class Engine:
                 f"need a larger context window"
             )
         if req.grammar and self._draft is not None:
-            raise ValueError(
-                "grammar-constrained decoding is not supported with a "
-                "draft model (the grammar mask must advance per token)")
-        if req.mm_embeds is not None:
-            if self._draft is not None:
+            if not self._ragged:
                 raise ValueError(
-                    "multimodal prompts are not supported with a draft "
-                    "model (the draft has no vision tower)")
+                    "grammar-constrained decoding with a draft model needs "
+                    "ragged continuous batching (the spec-as-ragged verify "
+                    "threads the device grammar tables; the dense spec "
+                    "program has no grammar lane)")
+            # the verify window masks come from the DEVICE tables (the host
+            # cannot resync inside the fused draft+verify program), so the
+            # grammar must compile to a bounded automaton that fits the cap
+            if not self._gtab_cap or self._compile_grammar(
+                    req.grammar).table(self._gtab_cap) is None:
+                raise ValueError(
+                    "grammar automaton exceeds grammar_table_states; "
+                    "speculative verify needs the precompiled device "
+                    "grammar table (raise grammar_table_states or drop "
+                    "the draft model for this grammar)")
+        if req.mm_embeds is not None:
+            if self._draft is not None and not self._ragged:
+                raise ValueError(
+                    "multimodal prompts with a draft model need ragged "
+                    "continuous batching (feature rows pack into the flat "
+                    "stream; the bucketed dense prefill has no draft-side "
+                    "path). The draft itself ingests token ids only.")
             emb = np.asarray(req.mm_embeds, np.float32)
             pos = np.asarray(req.mm_positions, np.int64)
             if emb.ndim != 2 or emb.shape[1] != self.cfg.hidden_size:
@@ -1524,16 +1777,25 @@ class Engine:
 
     def _compile_grammar(self, grammar: str):
         """Compile (or fetch cached) GBNF → CompiledGrammar. Called from gRPC
-        handler threads (submit-time validation) AND the engine loop thread,
-        so both the lazy init and the cache access are lock-protected."""
-        with self._grammar_lock:
-            if self._grammar_cache is None:
-                if self.tok is None:
-                    raise ValueError("grammar constraint requires a tokenizer")
-                from localai_tpu.functions.matcher import GrammarCache
+        handler threads (submit-time validation) AND the engine loop thread.
+        Only the lazy GrammarCache INIT is held under _grammar_lock (it walks
+        the whole vocab once); the compile itself — which may include a slow
+        device-table precompilation — runs outside any engine lock. The
+        cache is internally thread-safe (functions/matcher.GrammarCache:
+        double-checked insert), so a slow grammar compile never blocks other
+        handler threads' cache hits or the engine loop."""
+        cache = self._grammar_cache
+        if cache is None:
+            with self._grammar_lock:
+                if self._grammar_cache is None:
+                    if self.tok is None:
+                        raise ValueError(
+                            "grammar constraint requires a tokenizer")
+                    from localai_tpu.functions.matcher import GrammarCache
 
-                self._grammar_cache = GrammarCache(self.tok)
-            return self._grammar_cache.get(grammar)
+                    self._grammar_cache = GrammarCache(self.tok)
+                cache = self._grammar_cache
+        return cache.get(grammar)
 
     def _matcher_for(self, grammar: str):
         return self._compile_grammar(grammar).state()
@@ -1547,6 +1809,16 @@ class Engine:
         # on purpose: donation makes the state unrecoverable.
         try:
             matcher = self._matcher_for(req.grammar) if req.grammar else None
+            # device grammar tables: installed once per grammar (BFS +
+            # upload happen off the decode hot path); gbase None = overflow
+            # → the slot keeps per-token host masks (and bars the loop)
+            gbase = (self._grammar_table_entry(req.grammar)
+                     if req.grammar else None)
+            if req.grammar and gbase is None and self._draft is not None:
+                # shared-capacity overflow after submit's buildability check
+                # (other grammars filled the table): reject per-request —
+                # spec verify cannot host-resync
+                raise ValueError("grammar table capacity exhausted")
             n = len(req.prompt_ids)
             chunked = n > self._small_max
             bucket = None if chunked else self._bucket(n)
@@ -1560,12 +1832,14 @@ class Engine:
             ))
             return False
         mm = req.mm_embeds is not None
-        if self._ragged and not mm:
+        if self._ragged:
             # ragged admissions are always chunked: admission itself becomes
             # host-only slot bookkeeping, and the prompt is packed unpadded
             # into mixed ragged ticks — no bucket padding, no admission-time
-            # device dispatch (multimodal keeps the dense path: feature
-            # injection is outside the flat-stream program)
+            # device dispatch. Multimodal prompts pack too: their feature
+            # rows ride the flat stream as per-row embedding overrides
+            # (ragged_forward's inject), so mm prompts no longer force the
+            # bucketed dense prefill
             chunked, bucket = True, None
         if self._tiered and not pol.windowed:
             # admission-time policy demotion: a full-policy request that
@@ -1746,8 +2020,20 @@ class Engine:
             self._prefillq.append(slot)
         if matcher is not None:
             eos = self.tok.eos_ids if self.tok else ()
-            self._mask_host[slot] = matcher.mask_bits(eos)
             self._grammar_slots += 1
+            slot_obj.gbase = gbase
+            if gbase is not None:
+                # table-backed slot: start in the grammar's initial state;
+                # the host mask row materializes from the table mirror (u32
+                # LSB-first words view as the same LSB-first u8 bytes), so
+                # the per-token V-trial matcher mask walk is skipped for
+                # the whole life of the request
+                self._gstate[slot] = gbase
+                self._mask_host[slot] = self._gmasks_np[gbase].view(
+                    np.uint8)[:self._mask_nbytes]
+            else:
+                self._grammar_hostonly += 1
+                self._mask_host[slot] = matcher.mask_bits(eos)
         self.metrics["prompt_tokens_processed"] += n - lcp
         if not chunked and self._draft is not None:
             # spec invariant: the first token is sampled (and emitted) at
@@ -1779,12 +2065,11 @@ class Engine:
         for _ in range(budget):
             pq = self._prefillq
             if self._ragged_now():
-                # ragged mode packs token-level prefill into mixed ragged
-                # ticks (_ragged_tick); only multimodal prompts — excluded
-                # from the flat-stream program by their feature injection —
-                # still take the dense chunked path here
-                pq = [i for i in self._prefillq
-                      if self._slots[i].req.mm_embeds is not None]
+                # ragged mode packs ALL token-level prefill — multimodal
+                # included, via the flat-stream injection lane — into mixed
+                # ragged ticks (_ragged_tick / _spec_ragged_tick); nothing
+                # takes the dense chunked path here
+                pq = []
             if pq:
                 idx = pq[0]
                 slot = self._slots[idx]
@@ -1966,7 +2251,10 @@ class Engine:
         (the device cannot see the host queue mid-dispatch)."""
         if self._decode_loop_fn is None or self._draft is not None:
             return False
-        if self._grammar_slots > 0 or self._prefillq:
+        # table-backed grammar slots ride the loop (the device gathers each
+        # step's mask row and advances the automaton state); only automata
+        # that OVERFLOWED the table still need per-token host masks
+        if self._grammar_hostonly > 0 or self._prefillq:
             return False
         if self._free and not self._queue.empty():
             return False
@@ -1999,7 +2287,9 @@ class Engine:
             res[i] = int(min(G, remaining[i]))
             self._slots[i].inflight += res[i]
         self._inflight_steps = G
-        fetch = self._dev_decode_loop(active, remaining, check_eos, fast)
+        fetch = self._dev_decode_loop(
+            active, remaining, check_eos, fast,
+            gstate=self._gstate.copy() if self._grammar_slots > 0 else None)
         return ("loop", fetch, live, res)
 
     def _dispatch(self):
@@ -2217,22 +2507,171 @@ class Engine:
         return (any(s is not None for s in self._slots)
                 or not self._queue.empty() or self._deferred is not None)
 
+    def _step_spec_ragged(self) -> bool:
+        """Draft+ragged iteration: ONE spec-as-ragged dispatch per tick —
+        gamma draft steps plus a ragged target verify whose flat stream
+        holds every verifying slot's (gamma+1)-row window AND any packed
+        prefill chunks (multimodal inject rows included). This is the path
+        a mixed tenant soup rides: spec, grammar, mm and plain traffic all
+        share the one program (engine/spec.py build_spec_ragged)."""
+        self._prefill_tick()   # ragged admissions are host-only bookkeeping,
+        # so new arrivals can pack into THIS tick's stream
+        active = self._active_mask()
+        if active.any() or self._ragged_chunkable():
+            self._spec_ragged_tick(active, self._ragged_chunkable())
+        return (any(s is not None for s in self._slots)
+                or not self._queue.empty() or self._deferred is not None)
+
+    def _spec_ragged_tick(self, active, chunkable: list[int]):
+        """Pack verify windows + prefill chunks into one flat [T] stream and
+        dispatch a single spec-as-ragged program. Layout contract matches
+        _ragged_tick (QBLK-aligned per-seq q blocks, seq index == slot
+        index), except a verifying slot spans ceil((gamma+1)/QBLK) blocks —
+        the draft window is spliced into its rows ON DEVICE (the window
+        tokens live in device state; the host ships zeros)."""
+        from localai_tpu.ops.pallas import QBLK
+        B = self.ec.max_slots
+        T = self._ragged_rows
+        G = self.ec.gamma
+        winb = -(-(G + 1) // QBLK)
+        block_seq = np.full((T // QBLK,), -1, np.int32)
+        tokens = np.zeros((T,), np.int32)
+        verify = np.zeros((B,), bool)
+        spec_rows = np.zeros((B,), np.int32)
+        qstart = np.zeros((B,), np.int32)
+        qlen = np.zeros((B,), np.int32)
+        kvlen = np.zeros((B,), np.int32)
+        set_len = np.full((B,), -1, np.int32)
+        logit_set = np.zeros((B,), bool)
+        logit_rows = np.zeros((B, G + 1), np.int32)
+        row = 0
+        cap = T - QBLK   # one q-block always reserved for prefill
+        entries = []
+        order = [(self._ragged_rr + j) % B for j in range(B)]
+        self._ragged_rr = (self._ragged_rr + 1) % max(B, 1)
+        for i in order:
+            if not active[i]:
+                continue
+            s = self._slots[i]
+            if row + winb * QBLK > cap:
+                break
+            n = s.prompt_len + s.generated - s.shifted
+            qstart[i], qlen[i], kvlen[i] = row, G + 1, n + G + 1
+            block_seq[row // QBLK: row // QBLK + winb] = i
+            spec_rows[i] = row
+            verify[i] = True
+            logit_rows[i] = row + np.arange(G + 1)
+            entries.append((i, s.request_id))
+            row += winb * QBLK
+        packed = len(entries) * (G + 1)
+        chunks = []
+        inj_extra = inj_mask = None
+        for idx in chunkable:
+            if T - row < QBLK:
+                break
+            s = self._slots[idx]
+            ids = s.req.prompt_ids
+            pos = s.prefill_pos
+            nvalid = min(len(ids) - pos, T - row, self._chunk)
+            tokens[row:row + nvalid] = ids[pos:pos + nvalid]
+            nb = -(-nvalid // QBLK)
+            block_seq[row // QBLK:row // QBLK + nb] = idx
+            final = pos + nvalid == len(ids)
+            qstart[idx], qlen[idx] = row, nvalid
+            kvlen[idx] = pos + nvalid
+            if final:
+                set_len[idx] = pos + nvalid
+                logit_set[idx] = True
+                # all G+1 logit rows point at the final prompt row, so the
+                # kernel's last_logits merge picks up the admission logits
+                logit_rows[idx, :] = row + nvalid - 1
+            if s.req.mm_embeds is not None:
+                mpos, emb = s.req.mm_positions, s.req.mm_embeds
+                lo = int(np.searchsorted(mpos, pos))
+                hi = int(np.searchsorted(mpos, pos + nvalid))
+                if hi > lo:
+                    if inj_extra is None:
+                        inj_extra = np.zeros(
+                            (T, self.cfg.hidden_size), np.float32)
+                        inj_mask = np.zeros((T,), bool)
+                    sel = (mpos[lo:hi] - pos).astype(np.int64) + row
+                    inj_extra[sel] = emb[lo:hi]
+                    inj_mask[sel] = True
+            chunks.append((idx, pos, nvalid, final))
+            packed += nvalid
+            row += nb * QBLK
+        pack = dict(verify=verify, tokens=tokens, spec_rows=spec_rows,
+                    set_len=set_len, logit_set=logit_set,
+                    logit_rows=logit_rows, block_seq=block_seq,
+                    qstart=qstart, qlen=qlen, kvlen=kvlen, packed=packed,
+                    # grammar verify masks come from the DEVICE tables
+                    # (submit() rejects draft+grammar automata that
+                    # overflow them), keyed by each slot's automaton state
+                    gstate=(self._gstate.copy()
+                            if self._grammar_slots > 0 else None),
+                    inject=(None if inj_extra is None
+                            else (inj_extra, inj_mask)))
+        fetch = self._dev_spec_ragged(pack)
+        # chunk bookkeeping overlaps the device step; the draft ingests each
+        # chunk's token ids through its own (tiny) prefill program
+        for idx, pos, nvalid, final in chunks:
+            s = self._slots[idx]
+            s.prefill_pos = pos + nvalid
+            buf = np.zeros((1, self._chunk), np.int32)
+            buf[0, :nvalid] = s.req.prompt_ids[pos:pos + nvalid]
+            self._dev_draft_ingest(buf, pos, idx)
+            if final:
+                self._dev_install(idx, s.row, s.counts_row)
+                s.prefilled = True
+                self._prefillq.remove(idx)
+                if self._slo is not None:
+                    s.prefill_done_t = time.monotonic()
+                    self._slo.observe("prefill", "all",
+                                      s.prefill_done_t - s.start_time)
+                    s.dispatches += 1
+                    s.path = "ragged"
+                tok, lp = self._dev_spec_admit_tail(idx)
+                self._emit(idx, s, tok, lp, time.monotonic(), path="spec")
+            elif self._slo is not None:
+                s.dispatches += 1
+                s.path = "ragged"
+        t0 = time.perf_counter()
+        tokens_out, n_out, logprobs_out, n_extra = fetch.wait()
+        self.metrics["host_sync_wait_ms"] += (time.perf_counter() - t0) * 1e3
+        now = time.monotonic()
+        emitted = 0
+        for i, rid in entries:
+            slot = self._slots[i]
+            if slot is None or slot.request_id != rid:
+                continue
+            self.metrics["draft_proposed"] += G
+            self.metrics["draft_accepted"] += int(n_extra[i])
+            if self._slo is not None:
+                slot.dispatches += 1
+            for j in range(int(n_out[i])):
+                slot = self._slots[i]
+                if slot is None or slot.request_id != rid:
+                    break  # finished mid-window (EOS/length/stop)
+                self._emit(i, slot, int(tokens_out[i, j]),
+                           float(logprobs_out[i, j]), now, path="spec")
+                emitted += 1
+        self._obs("sample", t0, tokens=emitted, steps=G + 1, rollbacks=0)
+        self._dispatch_gauges()
+
     # ------------------------------------------------------ ragged scheduling
 
     def _ragged_now(self) -> bool:
         """True when this tick may run the ragged mixed-dispatch path.
-        Grammar slots need a per-token host round trip (PDA mask advance)
-        which the flat-stream program has no lane for — dense ticks drain
-        them, then ragged resumes."""
-        return self._ragged and self._grammar_slots == 0
+        Grammar slots ride it too: the tick is consumed synchronously, so
+        the per-slot mask rows shipped with the pack are never stale — the
+        PDA (or its table mirror) advances before the next dispatch."""
+        return self._ragged
 
     def _ragged_chunkable(self) -> list[int]:
-        """Prefill-queue slots whose next chunk can ride the flat stream
-        (multimodal prompts stay on the dense extend path — feature
-        injection is outside the flat-stream program)."""
-        return [i for i in self._prefillq
-                if self._slots[i] is not None
-                and self._slots[i].req.mm_embeds is None]
+        """Prefill-queue slots whose next chunk can ride the flat stream.
+        Multimodal prompts pack too — their embedding chunks ride the
+        per-row injection lane (see the `inject` pack field)."""
+        return [i for i in self._prefillq if self._slots[i] is not None]
 
     def _step_ragged(self) -> bool:
         """Run one mixed ragged tick if there is prefill work to pack with
@@ -2305,6 +2744,7 @@ class Engine:
             row += QBLK
         packed = len(entries)
         chunks = []
+        inj_extra = inj_mask = None
         for idx in chunkable:
             if T - row < QBLK:
                 break
@@ -2325,6 +2765,21 @@ class Engine:
                 set_len[idx] = pos + nvalid
                 logit_set[idx] = True
                 logit_rows[idx] = row + nvalid - 1
+            if s.req.mm_embeds is not None:
+                # multimodal packing: this chunk's image-feature rows land
+                # at their flat-stream rows via the per-row injection lane
+                # (lazily allocated — text-only ticks skip the [T, H] cost)
+                mpos, emb = s.req.mm_positions, s.req.mm_embeds
+                lo = int(np.searchsorted(mpos, pos))
+                hi = int(np.searchsorted(mpos, pos + nvalid))
+                if hi > lo:
+                    if inj_extra is None:
+                        inj_extra = np.zeros(
+                            (T, self.cfg.hidden_size), np.float32)
+                        inj_mask = np.zeros((T,), bool)
+                    sel = (mpos[lo:hi] - pos).astype(np.int64) + row
+                    inj_extra[sel] = emb[lo:hi]
+                    inj_mask[sel] = True
             chunks.append((idx, pos, nvalid, final))
             packed += nvalid
             row += nb * QBLK
@@ -2332,7 +2787,13 @@ class Engine:
                     is_decode=is_decode, set_len=set_len,
                     logit_set=logit_set, logit_rows=logit_rows,
                     block_seq=block_seq, qstart=qstart, qlen=qlen,
-                    kvlen=kvlen, packed=packed)
+                    kvlen=kvlen, packed=packed,
+                    # grammar decode slots sample under their CURRENT mask
+                    # rows — consumed synchronously below, so never stale
+                    mask=(self._mask_host.copy()
+                          if self._grammar_slots > 0 else None),
+                    inject=(None if inj_extra is None
+                            else (inj_extra, inj_mask)))
         fetch = self._dev_ragged(pack)
         for idx, pos, nvalid, final in chunks:
             s = self._slots[idx]
@@ -2441,7 +2902,10 @@ class Engine:
                     "decode_dispatches": self.metrics["decode_dispatches"],
                 })
         if self._draft is not None:
-            return self._step_spec()
+            # draft + ragged = spec-as-ragged: every tick is ONE dispatch
+            # covering verify windows + prefill chunks (mm rows included)
+            return (self._step_spec_ragged() if self._ragged
+                    else self._step_spec())
         if self._tiered:
             self._kv_tick()
         if self._ragged_now() and self._step_ragged():
@@ -2525,7 +2989,19 @@ class Engine:
                     finish = "stop"
             elif finish is None:
                 if slot.matcher.accept(token_id):
-                    self._mask_host[idx] = slot.matcher.mask_bits(eos)
+                    if slot.gbase is not None:
+                        # table-backed slot: advance the host mirror of the
+                        # device automaton and take the mask row straight
+                        # from the table (u32 LSB-first words view as the
+                        # same LSB-first u8 bytes) — skips the V-trial
+                        # matcher mask walk; matcher.accept above stays the
+                        # arbiter for done/can_continue/rollback
+                        st = int(self._gtrans_np[self._gstate[idx], token_id])
+                        self._gstate[idx] = st
+                        self._mask_host[idx] = self._gmasks_np[st].view(
+                            np.uint8)[:self._mask_nbytes]
+                    else:
+                        self._mask_host[idx] = slot.matcher.mask_bits(eos)
                     if (slot.matcher.done and not slot.matcher.can_continue
                             and not eos):
                         finish = "stop"  # complete and nothing can follow
@@ -2536,16 +3012,20 @@ class Engine:
 
         if slot.first_token_time is None:
             slot.first_token_time = now
-            self.metrics["ttft_ms_last"] = (now - slot.start_time) * 1e3
+            # TTFT from ARRIVAL (queued_t) — the user-perceived number,
+            # queue wait included; falls back to admission time for requests
+            # submitted without a queue timestamp
+            self.metrics["ttft_ms_last"] = \
+                (now - (slot.req.queued_t or slot.start_time)) * 1e3
         slot.generated += 1
         slot.gen_ids.append(token_id)
+        slot.path_counts[path] = slot.path_counts.get(path, 0) + 1
         self.metrics["tokens_generated"] += 1
         slo = self._slo
         if slo is not None:
             slot.path = path
             if slot.last_token_t is None:
-                # TTFT from ARRIVAL (queued_t), the user-perceived number;
-                # ttft_ms_last above keeps its admission-relative meaning
+                # TTFT from ARRIVAL (queued_t), matching ttft_ms_last above
                 slo.observe("ttft", path,
                             now - (slot.req.queued_t or slot.start_time))
                 slot.last_token_t = now
@@ -2970,6 +3450,11 @@ class Engine:
         if slot.matcher is not None:
             self._mask_host[idx] = 0xFF
             self._grammar_slots -= 1
+            self._gstate[idx] = 0  # row 0 = identity (all-ones, self-loop)
+            if slot.gbase is None:
+                self._grammar_hostonly -= 1
+        if self.record_paths:
+            self.req_path_counts[slot.request_id] = dict(slot.path_counts)
         windowed = False
         if self._tiered:
             pol = self._slot_policy[idx]
@@ -3069,17 +3554,52 @@ class Engine:
             ("ragged_dispatches", "ragged_tokens_packed")
             if self._ragged else ())}
         idle = np.zeros((B,), bool)
+        ones_mask = np.full((B, self._mask_nbytes), 0xFF, np.uint8)
+        idle_gstate = (np.zeros((B,), np.int32)
+                       if self._gtab_cap > 0 else None)
         try:
             if self._draft is not None:
-                self._dev_spec_decode(idle).wait()
+                if self._spec_ragged_fn is not None:
+                    # spec-as-ragged: warm every variant a mixed tenant soup
+                    # can reach (grammar tables x multimodal inject) so the
+                    # one-program tick never compiles mid-stream
+                    T = self._ragged_rows
+                    from localai_tpu.ops.pallas import QBLK
+                    G = self.ec.gamma
+                    base = dict(
+                        verify=idle,
+                        tokens=np.zeros((T,), np.int32),
+                        spec_rows=np.zeros((B,), np.int32),
+                        set_len=np.full((B,), -1, np.int32),
+                        logit_set=np.zeros((B,), bool),
+                        logit_rows=np.zeros((B, G + 1), np.int32),
+                        block_seq=np.full((T // QBLK,), -1, np.int32),
+                        qstart=np.zeros((B,), np.int32),
+                        qlen=np.zeros((B,), np.int32),
+                        kvlen=np.zeros((B,), np.int32),
+                        packed=0, gstate=None, inject=None)
+                    inj = (np.zeros((T, self.cfg.hidden_size), np.float32),
+                           np.zeros((T,), bool))
+                    variants = [dict(base)]
+                    if idle_gstate is not None:
+                        variants.append(dict(base, gstate=idle_gstate))
+                    variants.append(dict(base, inject=inj))
+                    if idle_gstate is not None:
+                        variants.append(dict(base, gstate=idle_gstate,
+                                             inject=inj))
+                    for pk in variants:
+                        self._dev_spec_ragged(pk).wait()
+                else:
+                    self._dev_spec_decode(idle).wait()
                 return
             if self._ragged:
-                # one all-dead pack compiles the ragged program (its shapes
-                # are fixed: [T] stream + [B] metadata, so one trace covers
-                # every future mix of decode rows and prefill chunks)
+                # all-dead packs compile the ragged program's variant set
+                # (shapes are fixed — [T] stream + [B] metadata — so one
+                # trace per mask/inject presence combination covers every
+                # future mix of decode rows, grammar slots and mm chunks)
                 T = self._ragged_rows
                 from localai_tpu.ops.pallas import QBLK
-                self._dev_ragged(dict(
+                base = dict(
                     tokens=np.zeros((T,), np.int32),
                     decode_slot=np.full((T,), -1, np.int32),
                     is_decode=np.zeros((B,), bool),
@@ -3090,7 +3610,13 @@ class Engine:
                     qstart=np.zeros((B,), np.int32),
                     qlen=np.zeros((B,), np.int32),
                     kvlen=np.zeros((B,), np.int32),
-                    packed=0)).wait()
+                    packed=0, mask=None, inject=None)
+                inj = (np.zeros((T, self.cfg.hidden_size), np.float32),
+                       np.zeros((T,), bool))
+                for pk in (dict(base), dict(base, mask=ones_mask),
+                           dict(base, inject=inj),
+                           dict(base, mask=ones_mask, inject=inj)):
+                    self._dev_ragged(pk).wait()
             widths = [None]
             W = self.ec.sampling_topk_width
             if W:
@@ -3103,6 +3629,15 @@ class Engine:
                         idle, np.zeros((B,), np.int32),
                         np.zeros((B,), bool), w).wait()
                 self._dev_decode(idle, None, w).wait()
+            if self._decode_loop_fn is not None and idle_gstate is not None:
+                # the grammar-table loop variant (full-sort sampling only —
+                # masked slots never ride a fast_width tier)
+                self._dev_decode_loop(idle, np.zeros((B,), np.int32),
+                                      np.zeros((B,), bool), None,
+                                      gstate=idle_gstate).wait()
+            # the dense masked step: the path every grammar config can
+            # still fall back to (host-only automata, decode_loop=0)
+            self._dev_decode(idle, ones_mask, None).wait()
             steps = self.ec.decode_block
             while steps > 1:
                 self._dev_decode_block(idle, steps, None, None).wait()

@@ -9,8 +9,10 @@ top-k/top-p (ops/sampling.sample).
 from __future__ import annotations
 
 import ctypes
+import dataclasses
 import functools
 import json
+import threading
 
 import numpy as np
 
@@ -38,6 +40,11 @@ def _lib():
     lib.gm_state_can_continue.argtypes = [ctypes.c_void_p]
     lib.gm_state_free.argtypes = [ctypes.c_void_p]
     lib.gm_free.argtypes = [ctypes.c_void_p]
+    lib.gm_table_build.restype = ctypes.c_int
+    lib.gm_table_build.argtypes = [
+        ctypes.c_void_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint32), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint8)]
     return lib
 
 
@@ -103,6 +110,24 @@ def token_texts(tok) -> list[str]:
     return out
 
 
+@dataclasses.dataclass(frozen=True)
+class GrammarTable:
+    """Dense automaton tables for device-side constrained decoding: the
+    whole token-reachable state set of one grammar, enumerated once off the
+    hot path (gm_table_build). State 0 is the initial state.
+
+    masks     [n_states, (V+31)//32] u32 — LSB-first allowed-token bitmask,
+              bit-compatible with MatcherState.mask_bits(()) (no EOS bits:
+              EOS policy is the engine's, injected per-tokenizer at install)
+    trans     [n_states, V] i32 — next state per token, -1 where masked off
+    accepting [n_states] u8 — a completed parse exists in this state
+    """
+    n_states: int
+    masks: np.ndarray
+    trans: np.ndarray
+    accepting: np.ndarray
+
+
 class CompiledGrammar:
     """A grammar compiled against a tokenizer's vocabulary."""
 
@@ -114,6 +139,7 @@ class CompiledGrammar:
             raise ValueError(f"grammar parse error: {err.value.decode()}")
         self.vocab_size = len(token_strings)
         self.nbytes = (self.vocab_size + 7) // 8
+        self.nwords = (self.vocab_size + 31) // 32
         blob = b"".join(s.encode() for s in token_strings)
         offsets = np.zeros(self.vocab_size + 1, np.int64)
         o = 0
@@ -126,9 +152,42 @@ class CompiledGrammar:
             offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
             self.vocab_size)
         self._lib = lib
+        self._tables: dict[int, GrammarTable | None] = {}
+        self._tables_lock = threading.Lock()
 
     def state(self) -> "MatcherState":
         return MatcherState(self)
+
+    def table(self, cap: int) -> GrammarTable | None:
+        """The grammar's dense device tables, or None when the reachable
+        state set exceeds `cap` (unbounded-nesting grammars never close —
+        those keep the per-token host matcher path). Memoized per cap; the
+        BFS enumeration runs OUTSIDE the lock (it trials every vocab token
+        from every state — slow is fine off the hot path, holding a lock
+        across it is not) with a double-checked insert."""
+        with self._tables_lock:
+            if cap in self._tables:
+                return self._tables[cap]
+        tbl = self._build_table(cap)
+        with self._tables_lock:
+            return self._tables.setdefault(cap, tbl)
+
+    def _build_table(self, cap: int) -> GrammarTable | None:
+        if cap <= 0:
+            return None
+        masks = np.zeros((cap, self.nwords), np.uint32)
+        trans = np.full((cap, self.vocab_size), -1, np.int32)
+        accepting = np.zeros(cap, np.uint8)
+        n = self._lib.gm_table_build(
+            self._g, cap,
+            masks.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            self.nwords,
+            trans.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            accepting.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+        if n < 0:
+            return None
+        return GrammarTable(n, masks[:n].copy(), trans[:n].copy(),
+                            accepting[:n].copy())
 
     def __del__(self):
         if getattr(self, "_g", None):
@@ -138,20 +197,27 @@ class CompiledGrammar:
 
 class GrammarCache:
     """Per-tokenizer cache of compiled grammars (token_texts is computed
-    once; grammar compiles are memoized by text)."""
+    once; grammar compiles are memoized by text). Thread-safe: request
+    handler threads and the engine loop both call get(); the compile runs
+    outside the lock with a double-checked insert, so a slow grammar
+    compile (or table precompilation behind it) never blocks other
+    threads' cache hits."""
 
     def __init__(self, tok):
         self._texts = token_texts(tok)
         self._cache: dict[str, CompiledGrammar] = {}
+        self._lock = threading.Lock()
 
     def get(self, gbnf: str) -> CompiledGrammar:
-        g = self._cache.get(gbnf)
-        if g is None:
-            g = CompiledGrammar(gbnf, self._texts)
+        with self._lock:
+            g = self._cache.get(gbnf)
+        if g is not None:
+            return g
+        g = CompiledGrammar(gbnf, self._texts)   # slow: outside the lock
+        with self._lock:
             if len(self._cache) > 32:
                 self._cache.clear()
-            self._cache[gbnf] = g
-        return g
+            return self._cache.setdefault(gbnf, g)
 
 
 class MatcherState:

@@ -790,7 +790,7 @@ def decode_step(params, cfg: LlamaConfig, tokens, lengths, cos, sin,
 
 def ragged_forward(params, cfg: LlamaConfig, tokens, cos, sin,
                    k_cache, v_cache, block_seq, qstart, qlen, kvlen,
-                   tables, logit_rows, kvt=None):
+                   tables, logit_rows, kvt=None, inject=None):
     """Mixed prefill+decode forward over ONE flat token stream (ragged
     continuous batching, arXiv:2604.15464): decode tokens and chunked-prefill
     windows from different requests pack into a single [T] stream and run as
@@ -807,7 +807,15 @@ def ragged_forward(params, cfg: LlamaConfig, tokens, cos, sin,
       block_seq [NQB=T/QBLK] — sequence id per q block, -1 for padding
       blocks. logit_rows [NSEQ] — flat row of each sequence's last token
       (decode rows and final prefill chunks; mid-prefill chunks may point
-      anywhere — their logits are ignored host-side).
+      anywhere — their logits are ignored host-side). A 2-D logit_rows
+      [NSEQ, R] gathers R rows per sequence instead (logits [NSEQ, R, V]) —
+      the spec-as-ragged verify pass needs the distribution at every row of
+      its draft window, not just the last.
+
+    inject: optional (extra [T, H] float, is_embed [T] bool) — rows with
+    is_embed take `extra` directly instead of the token-id embedding lookup
+    (multimodal prefill chunks pack their projected image/audio embeddings
+    into the same flat stream; reference: LLaVA-style mm prompt splicing).
 
     Everything per-ROW (rope positions, scatter targets) derives on device
     from that per-sequence metadata, so the host ships O(NSEQ) scalars, not
@@ -917,7 +925,11 @@ def ragged_forward(params, cfg: LlamaConfig, tokens, cos, sin,
         return ragged_attention_xla(qf, kc, vc, block_seq, qstart, qlen,
                                     kvlen, tables, sliding_window=sw)
 
-    x = params["embed"].astype(cfg.jdtype)[tokens][None]       # [1, T, H]
+    emb = params["embed"].astype(cfg.jdtype)[tokens]           # [T, H]
+    if inject is not None:
+        extra, is_embed = inject
+        emb = jnp.where(is_embed[:, None], extra.astype(cfg.jdtype), emb)
+    x = emb[None]                                              # [1, T, H]
 
     def layer(x, xs):
         lp, kc, vc = xs
@@ -941,7 +953,8 @@ def ragged_forward(params, cfg: LlamaConfig, tokens, cos, sin,
         layer, x, (params["layers"], k_cache, v_cache)
     )
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
-    last = x[0][logit_rows.astype(jnp.int32)]                  # [NSEQ, H]
+    # [NSEQ, H] for 1-D logit_rows, [NSEQ, R, H] for the 2-D spec windows
+    last = x[0][logit_rows.astype(jnp.int32)]
     logits = _lm_head(last.astype(jnp.float32), params)
     return logits, k_cache, v_cache
 
@@ -975,6 +988,16 @@ def build_decode_loop(step_fn, *, max_steps: int, limit: int):
     The loop EARLY-EXITS once every live slot froze — a dispatch costs only
     the steps it actually ran (`steps_run` proves it).
 
+    Grammar-constrained slots ride the same loop via the optional device
+    automaton tables (gstate [B] i32 per-slot state, gmasks [S, ceil(V/32)]
+    u32 packed allowed-token rows, gtrans [S, V] i32): each iteration
+    gathers the slot's mask row, hard-masks sampling with it (the fused
+    sample body's grammar path), and advances the state through gtrans on
+    the emitted token — no host resync inside the loop. State row 0 is the
+    all-ones/self-loop identity, so unconstrained slots stay bit-identical
+    to the maskless variant (an all-true jnp.where is the logits exactly,
+    and _draw is width-independent).
+
     Tokens land in an on-device ring buffer [max_steps, B]; the engine
     streams them out via async device→host copies (engine._AsyncFetch).
     Returns (tokens [max_steps, B], logprobs [max_steps, B], n_out [B],
@@ -984,14 +1007,19 @@ def build_decode_loop(step_fn, *, max_steps: int, limit: int):
 
     def decode_loop(params, cos, sin, kc, vc, sampler, last_logits, lengths,
                     active, remaining, check_eos, eos_ids, table=None,
-                    fast_width=None, kvt=None):
+                    fast_width=None, kvt=None, gstate=None, gmasks=None,
+                    gtrans=None):
         B = lengths.shape[0]
+        grammar = gmasks is not None
+        if gstate is None:
+            gstate = jnp.zeros((B,), jnp.int32)
         init = (
             jnp.int32(0),                            # steps run
             ~active,                                 # done (per slot)
             jnp.zeros((B,), jnp.int32),              # n_out
             jnp.zeros((max_steps, B), jnp.int32),    # token ring buffer
             jnp.zeros((max_steps, B), jnp.float32),  # logprob ring buffer
+            gstate,                                  # grammar automaton state
             kc, vc, sampler, last_logits, lengths,
         )
 
@@ -1000,13 +1028,14 @@ def build_decode_loop(step_fn, *, max_steps: int, limit: int):
             return (i < max_steps) & jnp.any(~done)
 
         def body(carry):
-            (i, done, n_out, toks, lps, kc, vc, sampler, last_logits,
-             lengths) = carry
+            (i, done, n_out, toks, lps, gstate, kc, vc, sampler,
+             last_logits, lengths) = carry
             live = ~done
             prev_key = sampler.key
+            mask = gmasks[gstate] if grammar else None
             tokens, lp, kc, vc, sampler, logits, lengths = step_fn(
                 params, cos, sin, kc, vc, sampler, last_logits, lengths,
-                live, None, fast_width, table, kvt)
+                live, mask, fast_width, table, kvt)
             # freeze finished slots: their key stream and last_logits hold
             # at the finishing token (step_fn already gates lengths and
             # token_counts on the active mask)
@@ -1019,13 +1048,20 @@ def build_decode_loop(step_fn, *, max_steps: int, limit: int):
             n_out = n_out + live.astype(jnp.int32)
             is_eos = check_eos & jnp.any(
                 tokens[:, None] == eos_ids[None, :], axis=1)
+            if grammar:
+                # advance the automaton on the emitted token; only a live
+                # slot's state moves. gtrans rows self-loop on EOS in
+                # accepting states and send masked-off tokens to the
+                # identity row 0 — neither is ever taken: sampling already
+                # excluded them.
+                gstate = jnp.where(live, gtrans[gstate, tokens], gstate)
             done = done | (live & (is_eos
                                    | (n_out >= remaining)
                                    | (lengths >= limit)))
-            return (i + 1, done, n_out, toks, lps, kc, vc, sampler,
+            return (i + 1, done, n_out, toks, lps, gstate, kc, vc, sampler,
                     last_logits, lengths)
 
-        (steps, _, n_out, toks, lps, kc, vc, sampler, last_logits,
+        (steps, _, n_out, toks, lps, _, kc, vc, sampler, last_logits,
          lengths) = jax.lax.while_loop(cond, body, init)
         return (toks, lps, n_out, steps, kc, vc, sampler, last_logits,
                 lengths)

@@ -503,6 +503,58 @@ int gm_state_done(State* s) { return s->done() ? 1 : 0; }
 int gm_state_can_continue(State* s) { return s->can_continue() ? 1 : 0; }
 int gm_state_stack_count(State* s) { return (int)s->stacks.size(); }
 
+// Enumerate every automaton state reachable from the initial state by
+// whole-token transitions (BFS with exact dedup on the stack-set identity)
+// and emit the dense device tables:
+//   masks     [cap, words] u32  LSB-first bit t = token t acceptable
+//   trans     [cap, n]     i32  next state index, -1 where the mask is 0
+//   accepting [cap]        u8   done() — a completed parse exists here
+// State 0 is the initial state. Returns the state count, or -1 when the
+// reachable set exceeds `cap` (recursive grammars with unbounded nesting
+// never close; callers fall back to the per-token host matcher).
+int gm_table_build(Grammar* g, int cap, uint32_t* masks, int words,
+                   int32_t* trans, uint8_t* accepting) {
+  int n = (int)g->tok_cps.size();
+  if (cap <= 0 || n <= 0) return -1;
+  std::map<std::set<Stack>, int> index;
+  std::vector<State> states;
+  {
+    State* init = gm_state_new(g);
+    states.push_back(*init);
+    delete init;
+  }
+  index[states[0].stacks] = 0;
+  for (size_t i = 0; i < states.size(); i++) {
+    State cur = states[i];  // copy: states reallocs under push_back below
+    uint32_t* mrow = masks + i * (size_t)words;
+    int32_t* trow = trans + i * (size_t)n;
+    memset(mrow, 0, (size_t)words * sizeof(uint32_t));
+    for (int t = 0; t < n; t++) trow[t] = -1;
+    accepting[i] = cur.done() ? 1 : 0;
+    for (int t = 0; t < n; t++) {
+      if (!g->tok_valid[t]) continue;
+      State trial = cur;
+      bool ok = true;
+      for (uint32_t cp : g->tok_cps[t])
+        if (!trial.accept_cp(cp)) { ok = false; break; }
+      if (!ok) continue;
+      mrow[t >> 5] |= (1u << (t & 31));
+      auto it = index.find(trial.stacks);
+      int nxt;
+      if (it != index.end()) {
+        nxt = it->second;
+      } else {
+        nxt = (int)states.size();
+        if (nxt >= cap) return -1;
+        index[trial.stacks] = nxt;
+        states.push_back(trial);
+      }
+      trow[t] = nxt;
+    }
+  }
+  return (int)states.size();
+}
+
 void gm_state_free(State* s) { delete s; }
 void gm_free(Grammar* g) { delete g; }
 

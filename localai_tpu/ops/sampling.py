@@ -142,7 +142,16 @@ def pipeline_logits(logits, state: SamplerState, mask_bits=None):
     b, v = logits.shape
     logits = logits.astype(jnp.float32)
     if mask_bits is not None:
-        bits = (mask_bits[:, :, None] >> jnp.arange(8, dtype=jnp.uint8)) & 1
+        # two wire formats, one semantic: u8 rows are the host matcher's
+        # per-step upload (LSB-first bytes); u32 rows are gathered from the
+        # device-resident grammar table (LSB-first words) — identical bit
+        # order, so either unpack yields the same allowed set
+        if mask_bits.dtype == jnp.uint32:
+            bits = (mask_bits[:, :, None]
+                    >> jnp.arange(32, dtype=jnp.uint32)) & 1
+        else:
+            bits = (mask_bits[:, :, None]
+                    >> jnp.arange(8, dtype=jnp.uint8)) & 1
         allowed = bits.reshape(b, -1)[:, :v].astype(bool)
         logits = jnp.where(allowed, logits, NEG_INF)
     logits = apply_penalties(logits, state)

@@ -59,7 +59,7 @@ def transfer_guard(level: str = "disallow"):
 DECODE_FN_ATTRS = (
     "_decode_fn", "_decode_nomask_fn", "_decode_fast_fn",
     "_decode_block_fn", "_decode_block_mask_fn", "_decode_loop_fn",
-    "_spec_fn", "_ragged_fn",
+    "_spec_fn", "_ragged_fn", "_spec_ragged_fn",
 )
 
 

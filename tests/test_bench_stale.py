@@ -108,8 +108,9 @@ def test_explicit_cpu_run_skips_stale_path(tmp_path, capsys, monkeypatch):
 
 
 def test_bench_help_exposes_trace_flag():
-    """The CI scoreboard-path assertion: bench.py --help names --trace."""
+    """The CI scoreboard-path assertion: bench.py --help names --trace and
+    the tier modes (longctx, soup)."""
     help_text = bench.build_parser().format_help()
     for flag in ("--trace", "--trace-out", "--runs-dir",
-                 "--allow-cpu-fallback"):
+                 "--allow-cpu-fallback", "longctx", "soup"):
         assert flag in help_text

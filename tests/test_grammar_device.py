@@ -1,0 +1,344 @@
+"""Device-side grammar tables (ISSUE 12): dense automaton tables vs the
+host matcher, and the engine paths that consume them — the fused decode
+loop, the ragged pack, and the speculative verify window.
+
+Table-unit cases run in tier-1; the engine parity sweeps are slow-marked
+and run standalone via `-m grammar`.
+"""
+import numpy as np
+import pytest
+
+from fixtures import tiny_checkpoint
+from localai_tpu.functions.grammars import JSON_GRAMMAR, json_schema_grammar
+from localai_tpu.functions.matcher import CompiledGrammar, GrammarCache
+
+pytestmark = pytest.mark.grammar
+
+
+# ------------------------------------------------------------ table units
+
+VOCAB = ['{', '}', '"', 'a', 'b', ':', ',', ' ', '0', '1', 'x']
+
+
+def _bits_of(mask_u32, nbytes):
+    return mask_u32.view(np.uint8)[:nbytes]
+
+
+def test_table_matches_matcher_walk():
+    """Every (state, token) the matcher can walk agrees with the dense
+    table: same allowed-token mask at each step, and trans[] lands in a
+    state whose mask equals the matcher's mask after accept."""
+    g = CompiledGrammar('root ::= "a" [01]+ ("x" | "b")?', VOCAB)
+    tbl = g.table(64)
+    assert tbl is not None and tbl.n_states >= 2
+    s = g.state()
+    st = 0
+    for tok in [VOCAB.index('a'), VOCAB.index('0'), VOCAB.index('1'),
+                VOCAB.index('x')]:
+        assert np.array_equal(_bits_of(tbl.masks[st], g.nbytes),
+                              s.mask_bits())
+        assert tbl.trans[st, tok] >= 0
+        assert s.accept(tok)
+        st = tbl.trans[st, tok]
+    assert tbl.accepting[st]
+    # masked-off tokens have no transition anywhere the mask bit is 0
+    for state in range(tbl.n_states):
+        bits = _bits_of(tbl.masks[state], g.nbytes)
+        for t in range(len(VOCAB)):
+            allowed = bits[t >> 3] >> (t & 7) & 1
+            assert (tbl.trans[state, t] >= 0) == bool(allowed)
+
+
+def test_table_accepting_tracks_matcher_done():
+    g = CompiledGrammar('root ::= "a" "b"', VOCAB)
+    tbl = g.table(16)
+    s = g.state()
+    st = 0
+    assert not tbl.accepting[st]
+    for tok in (VOCAB.index('a'), VOCAB.index('b')):
+        st = tbl.trans[st, tok]
+        s.accept(tok)
+    assert s.done and tbl.accepting[st]
+
+
+def test_table_overflow_returns_none():
+    """Unbounded-nesting grammars never close their token-reachable state
+    set — table() reports None and the engine keeps those on the per-token
+    host matcher path instead of shipping a truncated automaton."""
+    g = CompiledGrammar('root ::= "b" | "a" root "x"', VOCAB)
+    assert g.table(64) is None
+    # a closing grammar still overflows when the cap is below its state
+    # count — same None contract, memoized per cap
+    h = CompiledGrammar('root ::= "a" [01]+ ("x" | "b")?', VOCAB)
+    assert h.table(1) is None
+    assert h.table(64) is not None
+    assert h.table(1) is None  # memo keeps per-cap answers separate
+
+
+def test_table_memoized_per_cap():
+    g = CompiledGrammar('root ::= "a" "b"', VOCAB)
+    t1 = g.table(16)
+    assert g.table(16) is t1  # double-checked insert returns the cached one
+
+
+# ------------------------------------------------------------ engine paths
+
+SCHEMA = {"type": "object",
+          "properties": {"a": {"type": "integer"},
+                         "b": {"type": "string"}},
+          "required": ["a", "b"]}
+
+
+@pytest.fixture(scope="module")
+def loaded(tmp_path_factory):
+    from localai_tpu.engine import Tokenizer, load_config, load_params
+
+    ckpt = tiny_checkpoint(tmp_path_factory)
+    cfg = load_config(ckpt, dtype="float32")
+    params = load_params(ckpt, cfg)
+    tok = Tokenizer.from_dir(ckpt)
+    return cfg, params, tok
+
+
+def _drain(eng, reqs, steps=2000):
+    outs = [eng.submit(r) for r in reqs]
+    for _ in range(steps):
+        if not eng.step():
+            break
+    res = []
+    for _, q in outs:
+        ids, reason = [], None
+        while not q.empty():
+            o = q.get_nowait()
+            if o.token_id >= 0:
+                ids.append(o.token_id)
+            if o.finished:
+                reason = o.finish_reason
+        res.append((ids, reason))
+    return res
+
+
+def _greq(tok, temp=0.0, seed=5, n=24, g=None):
+    from localai_tpu.engine import GenRequest
+    from localai_tpu.ops.sampling import SamplingParams
+
+    return GenRequest(tok.encode("emit json:"),
+                      SamplingParams(temperature=temp, seed=seed),
+                      max_tokens=n,
+                      grammar=g or json_schema_grammar(SCHEMA))
+
+
+def _preq(tok, n=10):
+    from localai_tpu.engine import GenRequest
+    from localai_tpu.ops.sampling import SamplingParams
+
+    return GenRequest(tok.encode("the quick brown fox"),
+                      SamplingParams(temperature=0.0),
+                      max_tokens=n, ignore_eos=True)
+
+
+def _assert_conformant(tok, gbnf, ids):
+    m = GrammarCache(tok).get(gbnf).state()
+    for t in ids:
+        if tok.eos_ids and t in tok.eos_ids:
+            return
+        assert m.accept(t), f"illegal token {t} ({tok.decode([t])!r})"
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("temp", [0.0, 0.9])
+def test_loop_grammar_parity_vs_host_masking(loaded, temp):
+    """A table-backed grammar slot rides the single-dispatch while loop and
+    emits the SAME stream as the host-masked per-step reference (greedy and
+    sampled — the loop's device mask gather + state advance is bit-exact
+    against mask_bits)."""
+    from localai_tpu.engine import Engine, EngineConfig
+
+    cfg, params, tok = loaded
+    e_tab = Engine(cfg, params, tok, EngineConfig(
+        max_slots=2, max_context=128, prefill_buckets=(16,),
+        prompt_cache=False))
+    # decode_block=1: every host-masked step samples under a FRESH mask
+    # (the fused-block rollback path re-keys the sampler on a stale-mask
+    # miss — a different, equally-valid stream)
+    e_host = Engine(cfg, params, tok, EngineConfig(
+        max_slots=2, max_context=128, prefill_buckets=(16,),
+        prompt_cache=False, grammar_table_states=0, decode_block=1,
+        decode_loop=0))
+    a = _drain(e_tab, [_greq(tok, temp)])
+    b = _drain(e_host, [_greq(tok, temp)])
+    assert a == b, (temp, a, b)
+    assert e_tab.metrics.get("grammar_table_states", 0) > 0
+    # the table engine must NOT have fallen back to per-token dispatches
+    assert e_tab.metrics["decode_dispatches"] < \
+        e_host.metrics["decode_dispatches"] / 4
+
+
+@pytest.mark.slow
+def test_ragged_grammar_parity(loaded):
+    """Grammar slots pack into the ragged stream alongside plain tenants
+    (greedy + sampled), matching the rollback-free dense reference; a
+    tables-off engine (hostonly masks) matches too."""
+    from localai_tpu.engine import Engine, EngineConfig
+
+    cfg, params, tok = loaded
+
+    def ec(**kw):
+        return EngineConfig(max_slots=4, max_context=128,
+                            prefill_buckets=(16, 64), prefill_chunk=16,
+                            kv_pages=10, prompt_cache=False, **kw)
+
+    e_rag = Engine(cfg, params, tok, ec(ragged_token_budget=64))
+    e_ref = Engine(cfg, params, tok, ec(decode_block=1, decode_loop=0))
+    reqs = lambda: [_greq(tok, 0.0), _preq(tok), _greq(tok, 0.9, seed=9)]
+    ra = _drain(e_rag, reqs())
+    rb = _drain(e_ref, reqs())
+    assert ra == rb, (ra, rb)
+    assert e_rag.metrics["ragged_dispatches"] > 0
+
+    e_rag0 = Engine(cfg, params, tok,
+                    ec(ragged_token_budget=64, grammar_table_states=0))
+    rc = _drain(e_rag0, [_greq(tok, 0.0), _preq(tok)])
+    assert rc == ra[:2], (rc, ra[:2])
+
+
+@pytest.mark.slow
+def test_ragged_overflow_grammar_hostonly(loaded):
+    """The recursive JSON grammar overflows the table and keeps the host
+    mask path: greedy parity holds exactly (path-independent); sampled
+    streams stay grammar-conformant (the fused-block fallback re-keys on
+    rollback, so exact sampled parity is not a contract there)."""
+    from localai_tpu.engine import Engine, EngineConfig
+
+    cfg, params, tok = loaded
+
+    def ec(**kw):
+        return EngineConfig(max_slots=4, max_context=128,
+                            prefill_buckets=(16, 64), prefill_chunk=16,
+                            kv_pages=10, prompt_cache=False, **kw)
+
+    e_rag = Engine(cfg, params, tok, ec(ragged_token_budget=64))
+    e_ref = Engine(cfg, params, tok, ec(decode_block=1, decode_loop=0))
+    rj = _drain(e_rag, [_greq(tok, 0.0, g=JSON_GRAMMAR), _preq(tok)])
+    rk = _drain(e_ref, [_greq(tok, 0.0, g=JSON_GRAMMAR), _preq(tok)])
+    assert rj == rk, (rj, rk)
+    assert e_rag.metrics.get("grammar_table_overflows", 0) > 0
+    rs = _drain(e_rag, [_greq(tok, 0.9, seed=3, g=JSON_GRAMMAR)])
+    _assert_conformant(tok, JSON_GRAMMAR, rs[0][0])
+
+
+@pytest.mark.slow
+def test_mm_packed_prefill_parity(loaded):
+    """Multimodal embedding chunks pack into the flat ragged stream (the
+    per-row inject lane) and produce the same stream as the dense mm
+    prefill path."""
+    from localai_tpu.engine import Engine, EngineConfig, GenRequest
+    from localai_tpu.ops.sampling import SamplingParams
+
+    cfg, params, tok = loaded
+    embed = np.asarray(params["embed"], np.float32)
+    prompt = tok.encode("the quick brown fox jumps over")
+
+    def mmreq():
+        r = GenRequest(list(prompt), SamplingParams(temperature=0.0),
+                       max_tokens=10, ignore_eos=True)
+        r.mm_embeds = embed[prompt[1:4]] + 0.25
+        r.mm_positions = np.arange(1, 4)
+        return r
+
+    def ec(**kw):
+        return EngineConfig(max_slots=4, max_context=128,
+                            prefill_buckets=(16, 64), prefill_chunk=16,
+                            kv_pages=10, prompt_cache=False, **kw)
+
+    e_rag = Engine(cfg, params, tok, ec(ragged_token_budget=64))
+    e_ref = Engine(cfg, params, tok, ec(decode_block=1, decode_loop=0))
+    ma = _drain(e_rag, [mmreq(), _preq(tok)])
+    mb = _drain(e_ref, [mmreq(), _preq(tok)])
+    assert ma == mb, (ma, mb)
+    assert e_rag.metrics["ragged_dispatches"] > 0
+
+
+@pytest.mark.slow
+def test_spec_as_ragged_parity(loaded):
+    """Speculative decode as a ragged pack variant: the verify windows ride
+    ragged_forward and the token streams match the dense spec engine
+    exactly (same draft keys, same accept test)."""
+    from localai_tpu.engine import Engine, EngineConfig, load_config, \
+        load_params
+
+    cfg, params, tok = loaded
+
+    def ec(**kw):
+        return EngineConfig(max_slots=4, max_context=128,
+                            prefill_buckets=(16, 64), prefill_chunk=16,
+                            kv_pages=14, prompt_cache=False, gamma=3, **kw)
+
+    draft = (cfg, params)  # perfect draft: every proposal accepted
+    e_sr = Engine(cfg, params, tok, ec(ragged_token_budget=96), draft=draft)
+    e_sd = Engine(cfg, params, tok, ec(), draft=draft)
+    sa = _drain(e_sr, [_preq(tok, 16), _preq(tok, 16)])
+    sb = _drain(e_sd, [_preq(tok, 16), _preq(tok, 16)])
+    assert sa == sb, (sa, sb)
+    assert e_sr.metrics["ragged_dispatches"] > 0
+    assert e_sr.metrics["draft_accepted"] > 0
+
+
+@pytest.mark.slow
+@pytest.mark.tripwire
+def test_soup_tripwires_zero_fallback_zero_recompiles(loaded):
+    """The acceptance stream: grammar + multimodal + speculative + plain
+    tenants on ONE draft+ragged engine. After warmup and one warm stream,
+    a repeat soup adds ZERO compilations, stays inside the dispatch
+    budget, and never touches the dense fallback; every tenant's tokens
+    ride the spec-ragged path."""
+    from localai_tpu.engine import Engine, EngineConfig, GenRequest
+    from localai_tpu.ops.sampling import SamplingParams
+    from localai_tpu.testing.tripwires import (
+        CompileCounter, decode_cache_sizes, decode_compile_count,
+        dispatch_budget,
+    )
+
+    cfg, params, tok = loaded
+    embed = np.asarray(params["embed"], np.float32)
+    prompt = tok.encode("the quick brown fox jumps over")
+
+    def mmreq():
+        r = GenRequest(list(prompt), SamplingParams(temperature=0.0),
+                       max_tokens=10, ignore_eos=True)
+        r.mm_embeds = embed[prompt[1:3]] + 0.25
+        r.mm_positions = np.arange(1, 3)
+        return r
+
+    def soup():
+        return [_greq(tok, 0.0), mmreq(), _preq(tok, 12),
+                _greq(tok, 0.9, seed=11)]
+
+    eng = Engine(cfg, params, tok, EngineConfig(
+        max_slots=4, max_context=128, prefill_buckets=(16, 64),
+        prefill_chunk=16, kv_pages=14, prompt_cache=False, gamma=3,
+        ragged_token_budget=96), draft=(cfg, params))
+    eng.warmup()
+    eng.record_paths = True
+
+    out1 = _drain(eng, soup())  # warm stream (admit-tail mask variant etc.)
+    assert all(r[1] is not None for r in out1), out1
+    warm = decode_compile_count(eng)
+
+    d0, r0 = eng.metrics["decode_dispatches"], \
+        eng.metrics["ragged_dispatches"]
+    with CompileCounter() as cc, dispatch_budget(eng):
+        out2 = _drain(eng, soup())
+    assert all(r[1] is not None for r in out2), out2
+    assert cc.total == 0, cc.counts
+    assert decode_compile_count(eng) == warm, decode_cache_sizes(eng)
+    # zero dense fallback: every decode tick was a spec-ragged dispatch
+    dense = (eng.metrics["decode_dispatches"] - d0) \
+        - (eng.metrics["ragged_dispatches"] - r0)
+    assert dense == 0, eng.metrics
+    _assert_conformant(tok, json_schema_grammar(SCHEMA), out2[0][0])
+    _assert_conformant(tok, json_schema_grammar(SCHEMA), out2[3][0])
+    # per-tenant path accounting: every emitted token rode the spec path
+    assert len(eng.req_path_counts) >= 8
+    for counts in eng.req_path_counts.values():
+        assert set(counts) == {"spec"}, eng.req_path_counts
