@@ -100,6 +100,62 @@ def slo_stats(metrics: dict) -> dict:
     return out
 
 
+def sched_base(eng):
+    """Snapshot an engine's tick-ledger counters + token count before the
+    measured windows, so sched_stats can report window deltas (the compile
+    bursts between warmup and the windows also dispatch)."""
+    sched = getattr(eng, "_sched", None)
+    if sched is None:
+        return None
+    return (dict(sched.counters), dict(sched.variants),
+            int(eng.metrics.get("tokens_generated", 0)))
+
+
+def sched_stats(eng, base=None, *, toks_per_s=0.0, device_kind="",
+                chips=1) -> dict:
+    """Scheduler X-ray scoreboard fields (ISSUE 13) from a live engine:
+    tick-ledger aggregates (budget utilization, pad-row fraction, reason-
+    code counts, per-variant dispatch counts — deltas vs `base` when given)
+    plus the per-variant cost-analysis rooflines. When a throughput is
+    given, also computes the cost-backed `mfu`: measured tok/s times the
+    XLA-modeled FLOPs per generated token (sum of each variant's compiled
+    cost weighted by its dispatch count), over the chip peak — replacing
+    the old 2*N*tokens guess. rooflines() runs AFTER the measured windows
+    (AOT lowering is off the timed path and never touches the jit cache)."""
+    sched = getattr(eng, "_sched", None)
+    if sched is None:
+        return {}
+    try:
+        roofs = eng.rooflines()
+    except Exception:
+        roofs = {}
+    c0, v0, t0 = base or ({}, {}, 0)
+    reasons = {k: n - c0.get(k, 0) for k, n in sched.counters.items()
+               if n - c0.get(k, 0)}
+    variants = {k: n - v0.get(k, 0) for k, n in sched.variants.items()
+                if n - v0.get(k, 0)}
+    toks = int(eng.metrics.get("tokens_generated", 0)) - t0
+    out = {
+        "budget_utilization": round(sched.budget_utilization(), 4),
+        "pad_rows_frac": round(sched.pad_rows_frac(), 4),
+        "reason_codes": reasons,
+        "sched_variants": variants,
+    }
+    if roofs:
+        out["rooflines"] = {
+            name: {"cost_flops": r.get("cost_flops", 0.0),
+                   "cost_bytes": r.get("cost_bytes", 0.0),
+                   "bound": r.get("bound", ""),
+                   "mfu_ceiling": round(r.get("mfu", 0.0), 4)}
+            for name, r in roofs.items()}
+        flops = sum((roofs.get(v) or {}).get("cost_flops", 0.0) * n
+                    for v, n in variants.items())
+        if flops > 0 and toks > 0 and toks_per_s > 0:
+            peak = peak_flops_per_chip(device_kind) * max(chips, 1)
+            out["mfu"] = round(toks_per_s * (flops / toks) / peak, 4)
+    return out
+
+
 # ---------------------------------------------------------- run artifacts
 # The scoreboard contract (ROADMAP open item #1 / VERDICT round-5 ask #1):
 # BENCH_rN.json must never print `device: cpu` while a real on-chip artifact
@@ -616,6 +672,7 @@ def bench_engine(args, size: str, on_cpu: bool, kv_pages: int | None = None,
     t0 = time.perf_counter()
     eng.warmup()
     note(f"decode programs pre-compiled in {time.perf_counter() - t0:.1f}s")
+    sbase = sched_base(eng)   # ledger just reset; aligns the token counter
 
     t0 = time.perf_counter()
     for _ in range(args.slots):
@@ -659,6 +716,17 @@ def bench_engine(args, size: str, on_cpu: bool, kv_pages: int | None = None,
     m = eng.metrics
     d = max(m["decode_dispatches"], 1)
     stats = dispatch_stats(m)
+    dev0 = jax.devices()[0]
+    sstats = sched_stats(
+        eng, sbase, toks_per_s=statistics.median(tput),
+        device_kind=getattr(dev0, "device_kind", dev0.platform),
+        chips=tp if tp and tp > 1 else 1)
+    if sstats:
+        # cost-backed MFU rides separately so the result sites can place it
+        # under the top-level `mfu` key (the legacy estimate moves to
+        # mfu_analytic_legacy)
+        stats["mfu_cost"] = sstats.pop("mfu", None)
+        stats["sched"] = sstats
     note(f"engine: {m['decode_dispatches']} decode dispatches, "
          f"{m['decode_steps_dispatched']} steps "
          f"({m['decode_steps_dispatched'] / d:.1f} steps/dispatch), "
@@ -770,6 +838,7 @@ def _ragged_leg(args, cfg, params, context, kv_pages, budget, mixed):
     eng.warmup()
     burst(4)   # admission/prefill program compiles
     note(f"  programs compiled in {time.perf_counter() - t0:.1f}s")
+    base = sched_base(eng)
     tput, ttfts = [], []
     for _ in range(args.windows):
         tps, tt = burst(args.decode_steps)
@@ -779,12 +848,18 @@ def _ragged_leg(args, cfg, params, context, kv_pages, budget, mixed):
     rows = getattr(eng, "_ragged_rows", 0)
     util = (m.get("ragged_tokens_packed", 0)
             / max(m.get("ragged_dispatches", 0) * rows, 1))
+    import jax
+
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", dev.platform)
     ttfts.sort()
     return {
         "tok_s": st.median(tput),
         "ttft_p50_ms": ttfts[len(ttfts) // 2],
         "ttft_p95_ms": ttfts[min(len(ttfts) - 1, int(len(ttfts) * 0.95))],
         "budget_utilization": round(util, 4),
+        "sched": sched_stats(eng, base, toks_per_s=st.median(tput),
+                             device_kind=kind),
         "metrics": m,
     }
 
@@ -992,6 +1067,7 @@ def bench_soup(args, size: str, on_cpu: bool):
     note(f"plain: {st.median(plain_tps):.1f} tok/s")
     d0 = eng.metrics["decode_dispatches"]
     r0 = eng.metrics["ragged_dispatches"]
+    sbase = sched_base(eng)
     with dispatch_budget(eng):
         soup_tps = [burst(soup_kinds) for _ in range(args.windows)]
     note(f"soup : {st.median(soup_tps):.1f} tok/s "
@@ -1005,11 +1081,24 @@ def bench_soup(args, size: str, on_cpu: bool):
             agg[path] = agg.get(path, 0) + cnt
     dense_fallback = (eng.metrics["decode_dispatches"] - d0) \
         - (eng.metrics["ragged_dispatches"] - r0)
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", dev.platform)
+    sstats = sched_stats(eng, sbase, toks_per_s=st.median(soup_tps),
+                         device_kind=kind)
+    # every dense (non-ragged) dispatch in the soup windows emits exactly
+    # one dispatch-category reason code, so these sum to dense_fallback
+    from localai_tpu.telemetry import DISPATCH_CODES
+
+    fallback_reasons = {c: n for c, n in
+                        (sstats.get("reason_codes") or {}).items()
+                        if c in DISPATCH_CODES}
     result = {
         "tok_s": st.median(soup_tps),
         "plain_tok_s": st.median(plain_tps),
         "per_tenant_paths": per_tenant,
         "dense_fallback_dispatches": int(dense_fallback),
+        "dense_fallback_reasons": fallback_reasons,
+        "sched": sstats,
         "compile_count_delta": decode_compile_count(eng) - warm_compiles,
         "grammar_table_states": int(
             eng.metrics.get("grammar_table_states", 0)),
@@ -1441,7 +1530,11 @@ def emit_result(result: dict, args) -> int:
                     p50_ms=round(st["p50_ms"], 3),
                     count=st["count"],
                     tok_s=round(st["tok_s"], 1),
-                    **({"mfu": round(st["mfu"], 4)} if st.get("mfu") else {}))
+                    **({"mfu": round(st["mfu"], 4)}
+                       if st.get("mfu") else {}),
+                    **({"mfu_analytic_legacy":
+                        round(st["mfu_analytic_legacy"], 4)}
+                       if st.get("mfu_analytic_legacy") else {}))
                 for name, st in stages.items()}
             result["stage_coverage"] = round(profile.get("coverage", 0.0), 4)
         try:
@@ -1571,7 +1664,8 @@ def main(argv=None):
             "single_tok_s": round(single_tps, 2),
             "ttft_p50_ms": round(tp_ttft, 2),
             "single_ttft_p50_ms": round(single_ttft, 2),
-            "mfu": None if on_cpu else round(mfu, 4),
+            "mfu": stats.pop("mfu_cost", None),
+            "mfu_analytic_legacy": None if on_cpu else round(mfu, 4),
             "device": device_kind,
             "params": n_params,
             **stats,
@@ -1645,7 +1739,13 @@ def main(argv=None):
             "chips": 1,
             "tok_s_global": round(toks_per_s, 2),
             "tok_s_per_chip": round(toks_per_s, 2),
-            "mfu": None if on_cpu else round(mfu, 4),
+            "mfu": (ragged.get("sched") or {}).get("mfu"),
+            "mfu_analytic_legacy": None if on_cpu else round(mfu, 4),
+            "pad_rows_frac": (ragged.get("sched") or {}).get(
+                "pad_rows_frac"),
+            "reason_codes": (ragged.get("sched") or {}).get(
+                "reason_codes") or {},
+            "rooflines": (ragged.get("sched") or {}).get("rooflines") or {},
             "device": device_kind,
             "params": n_params,
             **dispatch_stats(ragged["metrics"]),
@@ -1677,11 +1777,18 @@ def main(argv=None):
                 toks_per_s / max(r["plain_tok_s"], 1e-9), 4),
             "per_tenant_paths": r["per_tenant_paths"],
             "dense_fallback_dispatches": r["dense_fallback_dispatches"],
+            "dense_fallback_reasons": r.get("dense_fallback_reasons") or {},
             "compile_count_delta": r["compile_count_delta"],
             "grammar_table_states": r["grammar_table_states"],
             "draft_acceptance": r["draft_acceptance"],
             "ragged_dispatches": int(
                 r["metrics"].get("ragged_dispatches", 0)),
+            "mfu": (r.get("sched") or {}).get("mfu"),
+            "budget_utilization": (r.get("sched") or {}).get(
+                "budget_utilization"),
+            "pad_rows_frac": (r.get("sched") or {}).get("pad_rows_frac"),
+            "reason_codes": (r.get("sched") or {}).get("reason_codes") or {},
+            "rooflines": (r.get("sched") or {}).get("rooflines") or {},
             "device": device_kind,
             **dispatch_stats(r["metrics"]),
         }
@@ -1715,7 +1822,8 @@ def main(argv=None):
             "tok_s_per_chip": round(toks_per_s, 2),
             "ttft_p50_ms": round(ttft_ms, 2),
             "dense_ttft_p50_ms": round(dense_ttft, 2),
-            "mfu": None if on_cpu else round(mfu, 4),
+            "mfu": stats.pop("mfu_cost", None),
+            "mfu_analytic_legacy": None if on_cpu else round(mfu, 4),
             "device": device_kind,
             "params": n_params,
             **stats,
@@ -1765,7 +1873,8 @@ def main(argv=None):
         "tok_s_global": round(toks_per_s, 2),
         "tok_s_per_chip": round(toks_per_s / chips, 2),
         "ttft_p50_ms": round(ttft_ms, 2),
-        "mfu": None if on_cpu else round(mfu, 4),
+        "mfu": stats.pop("mfu_cost", None),
+        "mfu_analytic_legacy": None if on_cpu else round(mfu, 4),
         "device": device_kind,
         "params": n_params,
         **stats,

@@ -602,6 +602,11 @@ class LLMServicer(BackendServicer):
             # ttft_ms_p50/p95) ride the same surface; the HTTP layer rebuilds
             # true Prometheus histogram series from these at scrape time
             m.update(slo.flat())
+        sched = getattr(self.engine, "_sched", None) if self.engine else None
+        if sched is not None:
+            # tick-ledger counters + any CACHED rooflines (sched_* keys —
+            # ISSUE 13); flat() never compiles, so scrapes stay cheap
+            m.update(sched.flat())
         return pb.MetricsResponse(metrics={k: float(v) for k, v in m.items()})
 
     def GetTrace(self, request, context):
@@ -615,6 +620,11 @@ class LLMServicer(BackendServicer):
             # the /debug/slo and /debug/flightrec lanes across the process
             # boundary, reusing the JSON-in-Reply transport
             "slo": slo.snapshot() if slo is not None else {},
+            # scheduler X-ray (ISSUE 13): recent tick records + reason-code
+            # counters + per-variant rooflines (the first call pays the
+            # per-variant AOT cost-analysis compile, then it's cached)
+            "sched": (self.engine.sched_snapshot()
+                      if self.engine is not None else {}),
             "flightrec": telemetry.flightrec().dump(),
             "pid": os.getpid(),
             "model": self.model_name,

@@ -251,15 +251,17 @@ def _add_util(sub):
     p = sub.add_parser("util",
                        help="model utilities (reference: core/cli util cmd)")
     p.add_argument("action", choices=["hf-info", "fits", "trace",
-                                      "flightrec"],
+                                      "flightrec", "sched"],
                    help="hf-info: checkpoint geometry + params; "
                             "fits: HBM fit estimate; "
                             "trace: pull a Chrome-trace + stage profile "
                             "from a running server's /debug endpoints; "
                             "flightrec: dump the server's flight recorder "
-                            "(recent request timelines + SLO percentiles)")
+                            "(recent request timelines + SLO percentiles); "
+                            "sched: scheduler X-ray (reason-code counters, "
+                            "pack composition, per-variant rooflines)")
     p.add_argument("model", help="checkpoint directory (hf-info/fits) or "
-                                 "server address (trace/flightrec)")
+                                 "server address (trace/flightrec/sched)")
     p.add_argument("--slots", type=int, default=8)
     p.add_argument("--context", type=int, default=2048)
     p.add_argument("--dtype", default="bfloat16")
@@ -362,6 +364,69 @@ def cli_util_flightrec(args) -> int:
     return 0
 
 
+def cli_util_sched(args) -> int:
+    """`local-ai util sched <addr>` — pull /debug/sched from a running
+    server and print the scheduler X-ray: reason-code counters grouped by
+    category, pack-composition totals (budget utilization, pad-row
+    fraction), per-variant dispatch counts with their cost-analysis
+    rooflines, and the most recent ticks. Raw JSON to --out when given."""
+    import json as _json
+    import sys as _sys
+    import urllib.request
+
+    base = args.model if args.model.startswith("http") \
+        else f"http://{args.model}"
+
+    req = urllib.request.Request(base + "/debug/sched")
+    if args.api_key:
+        req.add_header("Authorization", f"Bearer {args.api_key}")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        payload = _json.loads(r.read().decode())
+    if args.out:
+        with open(args.out, "w") as fh:
+            _json.dump(payload, fh, indent=1)
+        print(f"wrote {args.out}")
+    registry = payload.get("reason_codes") or {}
+    saw_any = False
+    for model, snap in (payload.get("models") or {}).items():
+        if not snap:
+            continue
+        saw_any = True
+        print(f"{model}: {snap.get('ticks_total', 0)} ticks, "
+              f"{snap.get('dispatches_total', 0)} dispatches")
+        util = snap.get("budget_utilization")
+        if util is not None:
+            print(f"  budget utilization {util:.1%}  "
+                  f"pad rows {snap.get('pad_rows_frac', 0):.1%}")
+        reasons = snap.get("reason_counters") or {}
+        if reasons:
+            width = max(len(c) for c in reasons)
+            print("  reason codes:")
+            for code, n in sorted(reasons.items(), key=lambda kv: -kv[1]):
+                cat = (registry.get(code) or {}).get("category", "?")
+                print(f"    {code:<{width}}  x{n:<8d} [{cat}]")
+        variants = snap.get("variants") or {}
+        roofs = snap.get("rooflines") or {}
+        if variants:
+            width = max(len(v) for v in variants)
+            print("  variants:")
+            for name, n in sorted(variants.items(), key=lambda kv: -kv[1]):
+                roof = roofs.get(name) or {}
+                extra = ""
+                if roof:
+                    extra = (f"  {roof.get('cost_flops', 0):.3g} flops  "
+                             f"{roof.get('cost_bytes', 0):.3g} B  "
+                             f"{roof.get('bound', '?')}-bound  "
+                             f"mfu≤{roof.get('mfu', 0):.1%}")
+                print(f"    {name:<{width}}  x{n:<8d}{extra}")
+        ticks = snap.get("recent_ticks") or []
+        if ticks:
+            print(f"  last tick: {_json.dumps(ticks[-1])}", file=_sys.stderr)
+    if not saw_any:
+        print("no scheduler ledger (run the backend with LOCALAI_SCHED=1)")
+    return 0
+
+
 def cli_util(args) -> int:
     import json as _json
 
@@ -369,6 +434,8 @@ def cli_util(args) -> int:
         return cli_util_trace(args)
     if args.action == "flightrec":
         return cli_util_flightrec(args)
+    if args.action == "sched":
+        return cli_util_sched(args)
 
     from localai_tpu.engine.loader import load_config
     from localai_tpu.system.memory import estimate, param_count

@@ -505,6 +505,13 @@ class Engine:
             # not the detok/stream fan-out) — per token this is the number
             # the decode-loop + copy_to_host_async work is driving to zero
             "host_sync_wait_ms": 0.0,
+            # per-path token attribution (ISSUE 13): always-on so live
+            # servers can compute constrained_over_plain-style ratios from
+            # GetMetrics, not just bench.py --mode soup
+            "tokens_by_path__loop": 0,
+            "tokens_by_path__ragged": 0,
+            "tokens_by_path__spec": 0,
+            "tokens_by_path__dense": 0,
         }
         if self._draft is not None:
             self.metrics["draft_proposed"] = 0
@@ -512,9 +519,11 @@ class Engine:
         if self._ragged:
             # token-budget utilization = ragged_tokens_packed /
             # (ragged_dispatches * ragged rows) — how full the flat stream
-            # runs (bench.py --mode ragged reports it)
+            # runs; always-on (ISSUE 13), maintained incrementally at each
+            # ragged dispatch so GetMetrics needs no recompute
             self.metrics["ragged_dispatches"] = 0
             self.metrics["ragged_tokens_packed"] = 0
+            self.metrics["budget_utilization"] = 0.0
         # per-request path attribution (bench.py --mode soup): opt-in so the
         # dict can't grow unbounded under a long-lived server
         self.record_paths = False
@@ -543,6 +552,16 @@ class Engine:
         self._flightrec = (telemetry.flightrec()
                            if self._slo is not None else None)
         self._tick_n = 0
+        # scheduler X-ray (ISSUE 13): the per-tick pack ledger — None when
+        # disabled (LOCALAI_SCHED=0 / LOCALAI_METRICS=0), keeping step() on
+        # the one-branch contract. Per-engine instance: bench runs several
+        # engines in one process and their streams must not mix.
+        self._sched = telemetry.maybe_ledger()
+        self._set_tick = telemetry.set_current_tick
+        # per-variant (jit fn, abstract arg shapes) captured at first
+        # dispatch — rooflines() AOT-lowers the SAME traced programs later
+        self._variant_avals: dict = {}
+        self._rooflines: dict | None = None
 
         # runtime tripwire (localai_tpu/testing/tripwires): with
         # LOCALAI_TRANSFER_GUARD set, every decode dispatch runs under
@@ -1175,6 +1194,10 @@ class Engine:
         else:
             self.metrics["grammar_table_overflows"] = (
                 self.metrics.get("grammar_table_overflows", 0) + 1)
+            if self._sched is not None:
+                self._sched.reason("grammar_table_overflow",
+                                   states=(0 if tbl is None
+                                           else int(tbl.n_states)))
         self._gtab_base[grammar] = base
         return base
 
@@ -1215,6 +1238,29 @@ class Engine:
             tr.add_complete("engine." + stage, t0, dur_s=dur, cat="engine",
                             args=dict(args, tokens=tokens,
                                       fenced=prof is not None))
+
+    def _sched_pack(self, variant: str, fn, fargs, fkw, **comp):
+        """Tick-ledger dispatch record (ISSUE 13): the pack composition of
+        one dispatch under its compiled-program variant name, plus a one-
+        time capture of the program's abstract arg shapes
+        (jax.ShapeDtypeStruct — no buffer refs, so donation can't dangle)
+        for the lazy AOT cost-analysis pass in rooflines(). One None-check
+        when the ledger is disabled."""
+        sched = self._sched
+        if sched is None:
+            return
+        if variant not in self._variant_avals:
+            try:
+                def _aval(x):
+                    if hasattr(x, "shape") and hasattr(x, "dtype"):
+                        return jax.ShapeDtypeStruct(x.shape, x.dtype)
+                    return x
+                self._variant_avals[variant] = (
+                    fn, jax.tree_util.tree_map(_aval, fargs),
+                    jax.tree_util.tree_map(_aval, fkw))
+            except Exception:
+                self._variant_avals[variant] = None
+        sched.pack(variant, **comp)
 
     def _dev_admit(self, ids, n, slot, row, counts_row, inject=None):
         # single admission == the K=1 batched case (the delegate broadcasts
@@ -1313,20 +1359,25 @@ class Engine:
                     self._kc, self._vc, self._sampler, self._last_logits,
                     self._lengths, jnp.asarray(active))
             if mask_host is not None:
-                (tokens, logprobs, self._kc, self._vc, self._sampler,
-                 self._last_logits, self._lengths) = self._decode_fn(
-                    *args, jnp.asarray(mask_host), table=self._tab(),
-                    kvt=self._kvt())
+                variant, fn = "decode_masked", self._decode_fn
+                fargs = (*args, jnp.asarray(mask_host))
+                fkw = dict(table=self._tab(), kvt=self._kvt())
             elif fast_width:
-                (tokens, logprobs, self._kc, self._vc, self._sampler,
-                 self._last_logits, self._lengths) = self._decode_fast_fn(
-                    *args, table=self._tab(), kvt=self._kvt(),
-                    fast_width=fast_width)
+                variant, fn = f"decode_fast{fast_width}", self._decode_fast_fn
+                fargs = args
+                fkw = dict(table=self._tab(), kvt=self._kvt(),
+                           fast_width=fast_width)
             else:
-                (tokens, logprobs, self._kc, self._vc, self._sampler,
-                 self._last_logits, self._lengths) = self._decode_nomask_fn(
-                    *args, table=self._tab(), kvt=self._kvt())
-        self._obs("decode", t0, tokens=int(np.sum(active)), fence=tokens,
+                variant, fn = "decode", self._decode_nomask_fn
+                fargs = args
+                fkw = dict(table=self._tab(), kvt=self._kvt())
+            n_act = int(np.sum(active))
+            B = self.ec.max_slots
+            self._sched_pack(variant, fn, fargs, fkw, decode_rows=n_act,
+                             rows_used=B, pad_rows=B - n_act, packed=n_act)
+            (tokens, logprobs, self._kc, self._vc, self._sampler,
+             self._last_logits, self._lengths) = fn(*fargs, **fkw)
+        self._obs("decode", t0, tokens=n_act, fence=tokens,
                   fast_width=fast_width or 0,
                   grammar=mask_host is not None)
         return _AsyncFetch((tokens, logprobs))
@@ -1344,15 +1395,23 @@ class Engine:
                     self._kc, self._vc, self._sampler, self._last_logits,
                     self._lengths, jnp.asarray(active))
             if mask_host is not None:
-                (tokens, logprobs, self._kc, self._vc, self._sampler,
-                 self._last_logits, self._lengths) = self._decode_block_mask_fn(
-                    *args, jnp.asarray(mask_host), table=self._tab(),
-                    kvt=self._kvt(), steps=steps, fast_width=None)
+                variant = f"decode_block{steps}_masked"
+                fn = self._decode_block_mask_fn
+                fargs = (*args, jnp.asarray(mask_host))
+                fkw = dict(table=self._tab(), kvt=self._kvt(), steps=steps,
+                           fast_width=None)
             else:
-                (tokens, logprobs, self._kc, self._vc, self._sampler,
-                 self._last_logits, self._lengths) = self._decode_block_fn(
-                    *args, table=self._tab(), kvt=self._kvt(), steps=steps,
-                    fast_width=fast_width)
+                variant, fn = f"decode_block{steps}", self._decode_block_fn
+                fargs = args
+                fkw = dict(table=self._tab(), kvt=self._kvt(), steps=steps,
+                           fast_width=fast_width)
+            n_act = int(np.sum(active))
+            B = self.ec.max_slots
+            self._sched_pack(variant, fn, fargs, fkw, decode_rows=n_act,
+                             rows_used=B, pad_rows=B - n_act,
+                             packed=steps * n_act)
+            (tokens, logprobs, self._kc, self._vc, self._sampler,
+             self._last_logits, self._lengths) = fn(*fargs, **fkw)
         self._obs("decode_block", t0, tokens=steps * int(np.sum(active)),
                   fence=tokens, steps=steps, fast_width=fast_width or 0,
                   grammar=mask_host is not None)
@@ -1383,13 +1442,21 @@ class Engine:
                 gmasks, gtrans = self._gtab()
                 gkw = dict(gstate=jnp.asarray(np.asarray(gstate, np.int32)),
                            gmasks=gmasks, gtrans=gtrans)
+            variant = ("loop" + (f"_fast{fast_width}" if fast_width else "")
+                       + ("_grammar" if gstate is not None else ""))
+            fargs = (self.params, self._cos, self._sin, self._kc, self._vc,
+                     self._sampler, self._last_logits, self._lengths,
+                     jnp.asarray(active), jnp.asarray(remaining),
+                     jnp.asarray(check_eos), self._eos_dev, self._tab())
+            fkw = dict(fast_width=fast_width, kvt=self._kvt(), **gkw)
+            n_act = int(np.sum(active))
+            B = self.ec.max_slots
+            self._sched_pack(variant, self._decode_loop_fn, fargs, fkw,
+                             decode_rows=n_act, rows_used=B,
+                             pad_rows=B - n_act, packed=n_act)
             (toks, lps, n_out, steps, self._kc, self._vc, self._sampler,
              self._last_logits, self._lengths) = self._decode_loop_fn(
-                self.params, self._cos, self._sin, self._kc, self._vc,
-                self._sampler, self._last_logits, self._lengths,
-                jnp.asarray(active), jnp.asarray(remaining),
-                jnp.asarray(check_eos), self._eos_dev, self._tab(),
-                fast_width=fast_width, kvt=self._kvt(), **gkw)
+                *fargs, **fkw)
         # tokens here is the RESERVED upper bound (actual count rides the
         # fetch); the consume-side "sample" stage records the exact number
         self._obs("decode_loop", t0,
@@ -1412,26 +1479,42 @@ class Engine:
         self.metrics["ragged_tokens_packed"] = (
             self.metrics.get("ragged_tokens_packed", 0)
             + int(pack["packed"]))
+        self.metrics["budget_utilization"] = (
+            self.metrics["ragged_tokens_packed"]
+            / max(self.metrics["ragged_dispatches"] * self._ragged_rows, 1))
         t0 = time.perf_counter()
         self._bcast("ragged", **dict(
             pack, inject=self._inj_msg(pack.get("inject"))))
         with activate_mesh(self.mesh), self._decode_guard():
             mask = pack.get("mask")
+            variant = ("ragged" + ("_mask" if mask is not None else "")
+                       + ("_inj" if pack.get("inject") is not None else ""))
+            fargs = (self.params, self._cos, self._sin, self._kc, self._vc,
+                     self._sampler, self._last_logits, self._lengths,
+                     jnp.asarray(pack["tokens"]),
+                     jnp.asarray(pack["decode_slot"]),
+                     jnp.asarray(pack["is_decode"]),
+                     jnp.asarray(pack["set_len"]),
+                     jnp.asarray(pack["logit_set"]),
+                     jnp.asarray(pack["logit_rows"]),
+                     jnp.asarray(pack["block_seq"]),
+                     jnp.asarray(pack["qstart"]), jnp.asarray(pack["qlen"]),
+                     jnp.asarray(pack["kvlen"]), self._tab(), self._kvt(),
+                     None if mask is None else jnp.asarray(mask),
+                     self._inj(pack.get("inject")))
+            n_dec = int(np.sum(pack["is_decode"]))
+            rows = int(pack.get("rows_used", 0))
+            inj = pack.get("inject")
+            self._sched_pack(
+                variant, self._ragged_fn, fargs, {},
+                decode_rows=n_dec,
+                prefill_tokens=int(pack["packed"]) - n_dec,
+                mm_rows=0 if inj is None else int(np.sum(inj[1])),
+                pad_rows=max(rows - int(pack["packed"]), 0),
+                rows_used=rows, budget_rows=self._ragged_rows,
+                packed=int(pack["packed"]))
             (tokens, logprobs, self._kc, self._vc, self._sampler,
-             self._last_logits, self._lengths) = self._ragged_fn(
-                self.params, self._cos, self._sin, self._kc, self._vc,
-                self._sampler, self._last_logits, self._lengths,
-                jnp.asarray(pack["tokens"]),
-                jnp.asarray(pack["decode_slot"]),
-                jnp.asarray(pack["is_decode"]),
-                jnp.asarray(pack["set_len"]),
-                jnp.asarray(pack["logit_set"]),
-                jnp.asarray(pack["logit_rows"]),
-                jnp.asarray(pack["block_seq"]),
-                jnp.asarray(pack["qstart"]), jnp.asarray(pack["qlen"]),
-                jnp.asarray(pack["kvlen"]), self._tab(), self._kvt(),
-                None if mask is None else jnp.asarray(mask),
-                self._inj(pack.get("inject")))
+             self._last_logits, self._lengths) = self._ragged_fn(*fargs)
         self._obs("ragged", t0, tokens=int(pack["packed"]), fence=tokens,
                   grammar=pack.get("mask") is not None)
         return _AsyncFetch((tokens, logprobs))
@@ -1450,6 +1533,9 @@ class Engine:
         self.metrics["ragged_tokens_packed"] = (
             self.metrics.get("ragged_tokens_packed", 0)
             + int(pack["packed"]))
+        self.metrics["budget_utilization"] = (
+            self.metrics["ragged_tokens_packed"]
+            / max(self.metrics["ragged_dispatches"] * self._ragged_rows, 1))
         t0 = time.perf_counter()
         self._bcast("spec_ragged", **dict(
             pack, inject=self._inj_msg(pack.get("inject"))))
@@ -1460,25 +1546,40 @@ class Engine:
                 gmasks, gtrans = self._gtab()
                 gkw = dict(gstate=jnp.asarray(np.asarray(gstate, np.int32)),
                            gmasks=gmasks, gtrans=gtrans)
+            variant = ("spec_ragged"
+                       + ("_grammar" if gstate is not None else "")
+                       + ("_inj" if pack.get("inject") is not None else ""))
+            fargs = (self.params, self._draft[1], self._cos, self._sin,
+                     self._cos_d, self._sin_d, self._kc, self._vc,
+                     self._kcd, self._vcd, self._sampler, self._last_logits,
+                     self._lengths, self._next_tokens,
+                     jnp.asarray(pack["verify"]),
+                     jnp.asarray(pack["tokens"]),
+                     jnp.asarray(pack["spec_rows"]),
+                     jnp.asarray(pack["set_len"]),
+                     jnp.asarray(pack["logit_set"]),
+                     jnp.asarray(pack["logit_rows"]),
+                     jnp.asarray(pack["block_seq"]),
+                     jnp.asarray(pack["qstart"]), jnp.asarray(pack["qlen"]),
+                     jnp.asarray(pack["kvlen"]), self._tab())
+            fkw = dict(kvt=self._kvt(),
+                       inject=self._inj(pack.get("inject")), **gkw)
+            n_win = int(np.sum(pack["verify"]))
+            win_toks = n_win * (self.ec.gamma + 1)
+            rows = int(pack.get("rows_used", 0))
+            inj = pack.get("inject")
+            self._sched_pack(
+                variant, self._spec_ragged_fn, fargs, fkw,
+                spec_windows=n_win,
+                prefill_tokens=int(pack["packed"]) - win_toks,
+                mm_rows=0 if inj is None else int(np.sum(inj[1])),
+                pad_rows=max(rows - int(pack["packed"]), 0),
+                rows_used=rows, budget_rows=self._ragged_rows,
+                packed=int(pack["packed"]))
             (tokens_out, n_out, logprobs_out, self._next_tokens,
              self._kc, self._vc, self._kcd, self._vcd, self._sampler,
              self._last_logits, self._lengths,
-             n_extra) = self._spec_ragged_fn(
-                self.params, self._draft[1], self._cos, self._sin,
-                self._cos_d, self._sin_d, self._kc, self._vc,
-                self._kcd, self._vcd, self._sampler, self._last_logits,
-                self._lengths, self._next_tokens,
-                jnp.asarray(pack["verify"]),
-                jnp.asarray(pack["tokens"]),
-                jnp.asarray(pack["spec_rows"]),
-                jnp.asarray(pack["set_len"]),
-                jnp.asarray(pack["logit_set"]),
-                jnp.asarray(pack["logit_rows"]),
-                jnp.asarray(pack["block_seq"]),
-                jnp.asarray(pack["qstart"]), jnp.asarray(pack["qlen"]),
-                jnp.asarray(pack["kvlen"]), self._tab(),
-                kvt=self._kvt(), inject=self._inj(pack.get("inject")),
-                **gkw)
+             n_extra) = self._spec_ragged_fn(*fargs, **fkw)
         self._obs("spec_ragged", t0, tokens=int(pack["packed"]),
                   fence=tokens_out, grammar=pack.get("gstate") is not None)
         return _AsyncFetch((tokens_out, n_out, logprobs_out, n_extra))
@@ -1570,13 +1671,23 @@ class Engine:
         t0 = time.perf_counter()
         self._bcast("spec", active=active)
         with activate_mesh(self.mesh):
+            fargs = (self.params, self._draft[1], self._cos, self._sin,
+                     self._cos_d, self._sin_d, self._kc, self._vc,
+                     self._kcd, self._vcd, self._sampler, self._lengths,
+                     self._next_tokens, jnp.asarray(active), self._tab())
+            n_act = int(np.sum(active))
+            B = self.ec.max_slots
+            if self._sched is not None:
+                # dense spec is a non-ragged decode dispatch: it needs its
+                # dispatch-category code for the fallback-sum invariant
+                self._sched.reason("spec_dense")
+            self._sched_pack("spec", self._spec_fn, fargs, {},
+                             spec_windows=n_act, rows_used=B,
+                             pad_rows=B - n_act,
+                             packed=n_act * (self.ec.gamma + 1))
             (tokens_out, n_out, logprobs_out, self._next_tokens,
              self._kc, self._vc, self._kcd, self._vcd, self._sampler,
-             self._lengths, n_extra) = self._spec_fn(
-                self.params, self._draft[1], self._cos, self._sin,
-                self._cos_d, self._sin_d, self._kc, self._vc,
-                self._kcd, self._vcd, self._sampler, self._lengths,
-                self._next_tokens, jnp.asarray(active), self._tab())
+             self._lengths, n_extra) = self._spec_fn(*fargs)
         self._obs("spec_decode", t0,
                   tokens=(self.ec.gamma + 1) * int(np.sum(active)),
                   fence=tokens_out)
@@ -1856,6 +1967,9 @@ class Engine:
             if base > self._maxb or base > len(self._kv_free):
                 pol = self._kv_policy
                 self.metrics["kv_policy_demotions"] += 1
+                if self._sched is not None:
+                    self._sched.reason("kv_policy_demotion", rid=rid,
+                                       blocks_needed=int(base))
         # multimodal: id-level prefix reuse would match the repeated image
         # token while the injected features differ — no slot or disk reuse
         slot, lcp = self._pick_slot([] if mm else req.prompt_ids)
@@ -1900,6 +2014,8 @@ class Engine:
                 # blocks free — the caller re-attempts on later ticks
                 self._free.append(slot)
                 self._deferred = (rid, req, out)
+                if self._sched is not None:
+                    self._sched.reason("kv_pool_exhausted", rid=rid)
                 return None
             lcp = eff
             if self._tiered:
@@ -2227,6 +2343,8 @@ class Engine:
             # 2G margin: with one block pipelined in flight, host-side
             # `generated` is stale by up to a full block when this guard runs
             if s.prompt_len + s.generated - s.shifted + 2 * G >= limit:
+                if self._sched is not None:
+                    self._sched.reason("context_margin")
                 return 1
             # remaining tokens, discounted by the ACTUAL in-flight
             # dispatch's staleness (not the max block size — the tail then
@@ -2239,26 +2357,41 @@ class Engine:
             while steps > 1 and steps * 2 > max(rem, 1):
                 steps //= 2
             if steps == 1:
+                if self._sched is not None:
+                    self._sched.reason("max_tokens_ladder")
                 return 1
+        if steps < G and self._sched is not None:
+            self._sched.reason("max_tokens_ladder")
         return steps
 
-    def _loop_eligible(self, entries) -> bool:
-        """Whether this dispatch can go loop-native (ONE while_loop dispatch,
-        stop conditions on device). Host-verified decisions keep the
-        block/ladder path: grammar masks and stop strings need per-token
+    def _loop_block_reason(self, entries) -> str | None:
+        """None when this dispatch can go loop-native (ONE while_loop
+        dispatch, stop conditions on device); otherwise the registered
+        reason code (telemetry.sched.REASON_CODES, "dispatch" category) for
+        why the block/ladder path runs instead. Host-verified decisions
+        keep the dense path: grammar masks and stop strings need per-token
         host checks, speculative decoding has its own fused program, and
         pending admissions/chunked prefills must not wait out a whole loop
         (the device cannot see the host queue mid-dispatch)."""
-        if self._decode_loop_fn is None or self._draft is not None:
-            return False
+        if self._decode_loop_fn is None:
+            return "loop_disabled"
+        if self._draft is not None:
+            return "draft_engine"
         # table-backed grammar slots ride the loop (the device gathers each
         # step's mask row and advances the automaton state); only automata
         # that OVERFLOWED the table still need per-token host masks
-        if self._grammar_hostonly > 0 or self._prefillq:
-            return False
+        if self._grammar_hostonly > 0:
+            return "grammar_hostonly"
+        if self._prefillq:
+            return "pending_prefill"
         if self._free and not self._queue.empty():
-            return False
-        return all(not self._slots[i].req.stop for i, _ in entries)
+            return "pending_admission"
+        if any(self._slots[i].req.stop for i, _ in entries):
+            return "stop_string"
+        return None
+
+    def _loop_eligible(self, entries) -> bool:
+        return self._loop_block_reason(entries) is None
 
     def _dispatch_loop(self, active, entries, fast):
         """Dispatch the fused while-loop block. Per-slot `remaining` budgets
@@ -2287,6 +2420,11 @@ class Engine:
             res[i] = int(min(G, remaining[i]))
             self._slots[i].inflight += res[i]
         self._inflight_steps = G
+        if self._sched is not None:
+            # the fast path is recorded too, so the dispatch-category codes
+            # stay exhaustive over dense dispatches (the fallback-sum
+            # invariant bench.py's dense_fallback_reasons relies on)
+            self._sched.reason("loop_native")
         fetch = self._dev_decode_loop(
             active, remaining, check_eos, fast,
             gstate=self._gstate.copy() if self._grammar_slots > 0 else None)
@@ -2312,8 +2450,14 @@ class Engine:
                   else None for i, _ in entries]
             if all(w is not None for w in ws):
                 fast = max(ws)
-        if self._loop_eligible(entries):
+        loop_block = self._loop_block_reason(entries)
+        if loop_block is None:
             return self._dispatch_loop(active, entries, fast)
+        if self._sched is not None:
+            # exactly ONE dispatch-category code per dense dispatch — this
+            # is what lets bench.py explain dense_fallback_dispatches as a
+            # sum of reason-code counts
+            self._sched.reason(loop_block)
         steps = self._block_steps()
         # snapshot the dispatch-time masks: _consume compares each slot's
         # refreshed mask against what the device sampled under, to catch the
@@ -2554,6 +2698,8 @@ class Engine:
                 continue
             s = self._slots[i]
             if row + winb * QBLK > cap:
+                if self._sched is not None:
+                    self._sched.reason("budget_cap", kind="verify_windows")
                 break
             n = s.prompt_len + s.generated - s.shifted
             qstart[i], qlen[i], kvlen[i] = row, G + 1, n + G + 1
@@ -2568,6 +2714,8 @@ class Engine:
         inj_extra = inj_mask = None
         for idx in chunkable:
             if T - row < QBLK:
+                if self._sched is not None:
+                    self._sched.reason("budget_cap", kind="prefill_chunks")
                 break
             s = self._slots[idx]
             ids = s.req.prompt_ids
@@ -2604,6 +2752,7 @@ class Engine:
                     set_len=set_len, logit_set=logit_set,
                     logit_rows=logit_rows, block_seq=block_seq,
                     qstart=qstart, qlen=qlen, kvlen=kvlen, packed=packed,
+                    rows_used=row,
                     # grammar verify masks come from the DEVICE tables
                     # (submit() rejects draft+grammar automata that
                     # overflow them), keyed by each slot's automaton state
@@ -2732,6 +2881,8 @@ class Engine:
             if s is None or not s.prefilled:
                 continue
             if row + QBLK > cap:
+                if self._sched is not None:
+                    self._sched.reason("budget_cap", kind="decode_rows")
                 break
             n = s.prompt_len + s.generated - s.shifted
             qstart[i], qlen[i], kvlen[i] = row, 1, n + 1
@@ -2747,6 +2898,8 @@ class Engine:
         inj_extra = inj_mask = None
         for idx in chunkable:
             if T - row < QBLK:
+                if self._sched is not None:
+                    self._sched.reason("budget_cap", kind="prefill_chunks")
                 break
             s = self._slots[idx]
             ids = s.req.prompt_ids
@@ -2787,7 +2940,7 @@ class Engine:
                     is_decode=is_decode, set_len=set_len,
                     logit_set=logit_set, logit_rows=logit_rows,
                     block_seq=block_seq, qstart=qstart, qlen=qlen,
-                    kvlen=kvlen, packed=packed,
+                    kvlen=kvlen, packed=packed, rows_used=row,
                     # grammar decode slots sample under their CURRENT mask
                     # rows — consumed synchronously below, so never stale
                     mask=(self._mask_host.copy()
@@ -2868,6 +3021,8 @@ class Engine:
                 self._demote_next[i] = raw + 1
                 if not self._cold or not self._cold_free:
                     self.metrics["kv_evictions"] += 1
+                    if self._sched is not None:
+                        self._sched.reason("kv_eviction", slot=i, block=raw)
                     continue
                 ci = self._cold_free.pop()
                 col = sb + (raw - sb) % max(int(self._kv_rw[i]), 1)
@@ -2875,6 +3030,8 @@ class Engine:
                 self._cold_table[i, raw] = ci
                 self._slot_cold[i].append(ci)
                 self.metrics["kv_cold_blocks"] += 1
+                if self._sched is not None:
+                    self._sched.reason("kv_cold_demotion", slot=i, block=raw)
                 self._dev_demote(pb, ci)
 
     def step(self) -> bool:
@@ -2883,14 +3040,27 @@ class Engine:
         N's tokens are pulled to the host, hiding the device→host sync +
         Python bookkeeping behind the next step's compute. Grammar-constrained
         batches run synchronously (the sampled token must update the PDA mask
-        before the next sample). Returns True while work remains."""
+        before the next sample). Returns True while work remains.
+
+        With the tick ledger live (ISSUE 13) each iteration runs bracketed
+        by begin()/commit(): the committed record — pack composition +
+        reason codes — feeds both /debug/sched's ring and the flight
+        recorder's tick ring, so a post-mortem shows the last N scheduling
+        DECISIONS, not just dispatch counts. Disabled, the overhead is the
+        two attribute loads + branch below."""
         if faults.fire("engine_crash") is not None:
             # chaos hook (LOCALAI_FAULT=engine_crash): a deterministic fatal
             # step — drives the _loop restart + flight-recorder post-mortem
             # path in tests; one env dict miss when disarmed
             raise RuntimeError("injected engine_crash (LOCALAI_FAULT)")
-        if self._flightrec is not None:
-            self._tick_n += 1
+        sched = self._sched
+        if sched is None and self._flightrec is None:
+            return self._step_inner()
+        self._tick_n += 1
+        self._set_tick(self._tick_n)
+        if sched is None:
+            # flight recorder without the ledger: keep the coarse summary
+            # every 64 ticks (the pre-ledger ring contents)
             if (self._tick_n & 63) == 0:
                 self._flightrec.record_tick({
                     "tick": self._tick_n,
@@ -2901,6 +3071,20 @@ class Engine:
                     "tokens_generated": self.metrics["tokens_generated"],
                     "decode_dispatches": self.metrics["decode_dispatches"],
                 })
+            return self._step_inner()
+        sched.begin(self._tick_n)
+        busy = self._step_inner()
+        rec = sched.commit(
+            active_slots=sum(s is not None for s in self._slots),
+            queued=self._queue.qsize(),
+            deferred=self._deferred is not None,
+            tokens_generated=self.metrics["tokens_generated"],
+            decode_dispatches=self.metrics["decode_dispatches"])
+        if self._flightrec is not None:
+            self._flightrec.record_tick(rec)
+        return busy
+
+    def _step_inner(self) -> bool:
         if self._draft is not None:
             # draft + ragged = spec-as-ragged: every tick is ONE dispatch
             # covering verify windows + prefill chunks (mm rows included)
@@ -3021,6 +3205,7 @@ class Engine:
         slot.gen_ids.append(token_id)
         slot.path_counts[path] = slot.path_counts.get(path, 0) + 1
         self.metrics["tokens_generated"] += 1
+        self.metrics["tokens_by_path__" + path] += 1
         slo = self._slo
         if slo is not None:
             slot.path = path
@@ -3551,7 +3736,8 @@ class Engine:
         snap = {k: self.metrics[k] for k in (
             "decode_dispatches", "decode_steps_dispatched",
             "host_sync_wait_ms") + (
-            ("ragged_dispatches", "ragged_tokens_packed")
+            ("ragged_dispatches", "ragged_tokens_packed",
+             "budget_utilization")
             if self._ragged else ())}
         idle = np.zeros((B,), bool)
         ones_mask = np.full((B, self._mask_nbytes), 0xFF, np.uint8)
@@ -3644,6 +3830,90 @@ class Engine:
                 steps //= 2
         finally:
             self.metrics.update(snap)
+            if self._sched is not None:
+                # keep the captured variant avals (rooflines needs them) but
+                # drop the warmup dispatches from the ledger stream — the
+                # serving/bench counters start clean, same as `snap` above
+                self._sched.reset()
+
+    def rooflines(self, force: bool = False) -> dict:
+        """Per-variant XLA cost analysis → roofline attribution (ISSUE 13).
+
+        AOT-lowers each captured decode/ragged/spec/loop variant with its
+        abstract arg shapes (jax.ShapeDtypeStruct — see _sched_pack) and
+        reads `compile().cost_analysis()` for FLOPs + bytes accessed. The
+        AOT compile does NOT populate the jit call cache, so the
+        compile-count tripwire (decode_compile_count) is unaffected — but
+        it IS a real XLA compile per variant, visible to jax.log_compiles:
+        call this off the measured path (bench: after the windows; server:
+        first /debug/sched or GetTrace). Results are cached on the engine
+        and mirrored into the tick ledger for GetMetrics `sched_roofline_*`
+        keys and the profiler's cost-backed per-stage MFU."""
+        if self._rooflines is not None and not force:
+            return self._rooflines
+        from localai_tpu import telemetry
+
+        kind = ""
+        try:
+            d = jax.devices()[0]
+            kind = getattr(d, "device_kind", d.platform)
+        except Exception:
+            pass
+        peak = telemetry.peak_flops(kind)
+        bw = telemetry.peak_bandwidth(kind)
+        out: dict[str, dict] = {}
+        for name, spec in list(self._variant_avals.items()):
+            if spec is None:
+                continue
+            fn, fargs, fkw = spec
+            try:
+                with activate_mesh(self.mesh):
+                    ca = fn.lower(*fargs, **fkw).compile().cost_analysis()
+            except Exception:
+                continue
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else None
+            if not ca:
+                continue
+            flops = float(ca.get("flops", 0.0))
+            bytes_ = float(ca.get("bytes accessed", 0.0))
+            if flops <= 0 and bytes_ <= 0:
+                continue
+            out[name] = telemetry.roofline_entry(flops, bytes_, peak, bw)
+        self._rooflines = out
+        if self._sched is not None:
+            self._sched.rooflines = out
+        if self._prof is not None and out:
+            # fold per-variant costs onto the profiler's stage names (the
+            # first matching variant stands for the stage — stages share
+            # one program modulo static knobs)
+            stage_of = (("spec_ragged", "spec_ragged"),
+                        ("decode_block", "decode_block"),
+                        ("loop", "decode_loop"), ("ragged", "ragged"),
+                        ("decode", "decode"), ("spec", "spec_decode"))
+            costs: dict[str, dict] = {}
+            for name, e in out.items():
+                for prefix, stage in stage_of:
+                    if name.startswith(prefix) and stage not in costs:
+                        costs[stage] = {"flops": e["cost_flops"],
+                                        "bytes": e["cost_bytes"]}
+                        break
+            self._prof.set_costs(costs)
+        return out
+
+    def sched_snapshot(self, ticks: int = 64,
+                       with_rooflines: bool = True) -> dict:
+        """Structured tick-ledger export for /debug/sched and GetTrace —
+        {} when the ledger is disabled. Computes (and caches) the roofline
+        pass on first call unless `with_rooflines` is False."""
+        if self._sched is None:
+            return {}
+        if with_rooflines:
+            try:
+                self.rooflines()
+            except Exception:
+                pass
+        return self._sched.snapshot(ticks)
 
     def start(self):
         """Run the engine loop in a background thread (serving mode)."""

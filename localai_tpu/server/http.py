@@ -67,6 +67,24 @@ try:
     # cumulative event counts → Counter (was a mis-typed Gauge)
     _SUPERVISION = Counter("localai_backend_supervision_total",
                            "Backend supervision events", ["model", "event"])
+    # scheduler X-ray (ISSUE 13): tick-ledger series refreshed from each
+    # backend's GetMetrics sched_* keys at scrape time
+    _SCHED_REASONS = Counter(
+        "localai_sched_reason_total",
+        "Scheduler decisions by registered reason code", ["model", "code"])
+    _SCHED_DISPATCHES = Counter(
+        "localai_sched_dispatches_total",
+        "Engine dispatches by compiled program variant",
+        ["model", "variant"])
+    _SCHED_TICKS = Counter(
+        "localai_sched_ticks_total", "Engine scheduler ticks", ["model"])
+    _SCHED_UTIL = Gauge(
+        "localai_sched_budget_utilization",
+        "Fraction of the ragged token budget carrying live tokens",
+        ["model"])
+    _SCHED_PAD = Gauge(
+        "localai_sched_pad_rows_frac",
+        "Fraction of allocated dispatch rows that were padding", ["model"])
     # last cumulative value each counter child was synced to, keyed by the
     # label tuple — a backend restart resets its counters, which _counter_sync
     # treats as a fresh start (standard Prometheus counter-reset semantics)
@@ -237,6 +255,9 @@ class API:
         # ticks, tripwire/breaker/supervision events)
         r.add_get("/debug/slo", self._debug_slo)
         r.add_get("/debug/flightrec", self._debug_flightrec)
+        # scheduler X-ray (ISSUE 13): per-tick pack ledger, reason-code
+        # counters, and per-variant cost-analysis rooflines
+        r.add_get("/debug/sched", self._debug_sched)
         r.add_get("/backend/monitor", self._backend_monitor)
         r.add_post("/backend/shutdown", self._backend_shutdown)
         r.add_get("/system", self._system)
@@ -644,6 +665,23 @@ class API:
             if hists:
                 _SLO_SCRAPE[name] = hists
             for key, v in m.items():
+                # scheduler X-ray series (ISSUE 13)
+                if key.startswith("sched_reason__"):
+                    _counter_sync(_SCHED_REASONS, (name, key[14:]), float(v))
+                    continue
+                if key.startswith("sched_variant__"):
+                    _counter_sync(_SCHED_DISPATCHES, (name, key[15:]),
+                                  float(v))
+                    continue
+                if key == "sched_ticks_total":
+                    _counter_sync(_SCHED_TICKS, (name,), float(v))
+                    continue
+                if key == "sched_budget_utilization":
+                    _SCHED_UTIL.labels(name).set(v)
+                    continue
+                if key == "sched_pad_rows_frac":
+                    _SCHED_PAD.labels(name).set(v)
+                    continue
                 if not key.startswith("prof_"):
                     continue
                 stage, _, kind = key[5:].rpartition("_")
@@ -717,6 +755,25 @@ class API:
             "metrics_enabled": telemetry.metrics_enabled(),
             "bucket_edges_s": [b for b in telemetry.BUCKETS_S
                                if b != float("inf")],
+            "models": models,
+        })
+
+    async def _debug_sched(self, request):
+        """GET /debug/sched[?model=x] → the scheduler X-ray (ISSUE 13): each
+        backend engine's tick-ledger snapshot — pack-composition totals,
+        admission/fallback/demotion reason-code counters, per-variant
+        dispatch counts and cost-analysis rooflines, plus the recent tick
+        ring. Empty per-model blocks unless the backend runs with
+        LOCALAI_SCHED=1 (and metrics enabled)."""
+        models = {}
+        for payload in await self._backend_traces(
+                request.query.get("model", "")):
+            models[payload["model"]] = payload.get("sched") or {}
+        return web.json_response({
+            "sched_enabled": telemetry.sched_enabled(),
+            "reason_codes": {code: {"category": cat, "description": desc}
+                             for code, (cat, desc)
+                             in telemetry.REASON_CODES.items()},
             "models": models,
         })
 

@@ -19,9 +19,15 @@ never been decomposed):
   and `/debug/slo`; plus the crash/tripwire flight recorder
   (`/debug/flightrec`, auto post-mortem dumps).
 
+- `sched` (ISSUE 13): the scheduler X-ray — a per-tick pack ledger with a
+  registered reason-code taxonomy for every admission/fallback/demotion
+  decision, plus XLA cost-analysis rooflines per compiled decode variant
+  (`/debug/sched`, GetMetrics `sched_*` keys, `local-ai util sched`).
+
 Enable with `LOCALAI_TRACE=1` (spans) and `LOCALAI_PROFILE=1` (fenced stage
 timing). Both default off; the serving hot path is untouched when disabled.
-SLO metrics default ON (`LOCALAI_METRICS=0` disables).
+SLO metrics default ON (`LOCALAI_METRICS=0` disables); the tick ledger
+rides the same gate (`LOCALAI_SCHED=0` disables it alone).
 """
 from localai_tpu.telemetry.trace import (  # noqa: F401
     Tracer,
@@ -56,4 +62,17 @@ from localai_tpu.telemetry.metrics import (  # noqa: F401
     reset_flightrec,
     set_metrics_enabled,
     snapshot_from_hists,
+)
+from localai_tpu.telemetry.sched import (  # noqa: F401
+    DISPATCH_CODES,
+    REASON_CODES,
+    TickLedger,
+    current_tick,
+    maybe_ledger,
+    peak_bandwidth,
+    reason_category,
+    roofline_entry,
+    sched_enabled,
+    set_current_tick,
+    set_sched_enabled,
 )

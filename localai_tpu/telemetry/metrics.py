@@ -272,6 +272,15 @@ class FlightRecorder:
 
     def record_event(self, kind: str, **fields):
         e = {"kind": kind, "t_wall": time.time(), **fields}
+        if "tick" not in e:
+            # stamp the live engine's tick id (ISSUE 13) so breaker/reap/
+            # tripwire events correlate with the scheduler tick stream; the
+            # import is deferred — sched imports this module
+            from localai_tpu.telemetry.sched import current_tick
+
+            tick = current_tick()
+            if tick is not None:
+                e["tick"] = tick
         self.events.append(e)
 
     def dump(self) -> dict:

@@ -10,9 +10,12 @@ serialized — profiling is a measurement mode, not a serving mode).
 Stage samples accumulate into log-spaced histograms so one snapshot answers
 "where do the milliseconds of a decode step go" (the Kernel Looping /
 PRESERVE-style per-stage attribution the 33 ms step needs): count, total,
-min/max, p50 (from the histogram), tokens/s, and — when the model's param
-count and the chip's peak FLOP/s are known — a per-stage MFU estimate using
-the 2·N·tokens decode-FLOP approximation.
+min/max, p50 (from the histogram), tokens/s, and two MFU numbers per stage:
+`mfu` — backed by XLA's per-program cost analysis when the engine has fed
+per-stage FLOP counts via set_costs() (ISSUE 13) — and
+`mfu_analytic_legacy`, the old 2·N·tokens decode-FLOP approximation (kept
+for scoreboard continuity; it overstates stages that don't run the full
+forward and knows nothing about bandwidth).
 
 Everything here is jax-free until a fence is actually requested, so the
 module can load in processes that never touch the accelerator.
@@ -102,7 +105,8 @@ class StepProfiler:
     already pays a fence per dispatch, a mutex is noise)."""
 
     def __init__(self, fence: bool = True, n_params: int = 0,
-                 peak: float = 0.0, mesh: dict | None = None):
+                 peak: float = 0.0, mesh: dict | None = None,
+                 peak_bw: float = 0.0):
         """`mesh` is the serving mesh shape ({'data': d, 'model': m, ...},
         None for single chip). It is recorded in every report and scales the
         MFU denominator by the chip count, so a TP profile can never be
@@ -110,15 +114,30 @@ class StepProfiler:
         self.fence = fence
         self.n_params = n_params
         self.peak = peak
+        self.peak_bw = peak_bw
         self.mesh = dict(mesh) if mesh else None
         self.chips = 1
         for size in (mesh or {}).values():
             self.chips *= max(int(size), 1)
         self._stages: dict[str, _Stage] = {}
         self._gauges: dict[str, float] = {}
+        self._costs: dict[str, dict] = {}
         self._lock = threading.Lock()
         self._first_t: float | None = None
         self._last_t: float = 0.0
+
+    def set_costs(self, costs: dict[str, dict]) -> None:
+        """Per-stage XLA cost analysis (ISSUE 13): stage name → {"flops":
+        per-dispatch FLOPs, "bytes": per-dispatch bytes accessed}, from
+        `jit(...).lower().compile().cost_analysis()` on the stage's compiled
+        program. Once set, report()/flat() emit the cost-backed `mfu`
+        (measured dispatch time against real FLOPs) beside the legacy
+        2·N·tokens estimate."""
+        with self._lock:
+            for stage, c in costs.items():
+                self._costs[stage] = {
+                    "flops": float(c.get("flops", 0.0)),
+                    "bytes": float(c.get("bytes", 0.0))}
 
     def set_gauges(self, **gauges: float) -> None:
         """Scalar engine-level gauges (dispatch-fusing telemetry: decode
@@ -162,11 +181,19 @@ class StepProfiler:
             total = 0.0
             for name, st in self._stages.items():
                 total += st.total_s
-                mfu = None
+                legacy = None
                 if self.peak and self.n_params and st.total_s > 0 \
                         and st.tokens:
                     # global tokens over the WHOLE mesh's peak: per-chip MFU
-                    mfu = (2.0 * self.n_params * st.tokens
+                    legacy = (2.0 * self.n_params * st.tokens
+                              / (st.total_s * self.peak * self.chips))
+                # cost-backed MFU (ISSUE 13): the stage's real compiled
+                # FLOPs per dispatch, over measured dispatch time and the
+                # mesh's peak — None until the engine feeds set_costs()
+                mfu = None
+                cost = self._costs.get(name)
+                if cost and cost["flops"] and self.peak and st.total_s > 0:
+                    mfu = (cost["flops"] * st.count
                            / (st.total_s * self.peak * self.chips))
                 stages[name] = {
                     "count": st.count,
@@ -179,6 +206,9 @@ class StepProfiler:
                     "tok_s": (st.tokens / st.total_s
                               if st.total_s > 0 else 0.0),
                     "mfu": mfu,
+                    "mfu_analytic_legacy": legacy,
+                    **({"cost_flops": cost["flops"],
+                        "cost_bytes": cost["bytes"]} if cost else {}),
                     "hist_bucket_upper_ms": [
                         b * 1e3 if math.isfinite(b) else None
                         for b in BUCKETS_S],
@@ -210,6 +240,16 @@ class StepProfiler:
                 out[f"{prefix}{name}_p50_ms"] = st.p50_s() * 1e3
                 if st.tokens and st.total_s > 0:
                     out[f"{prefix}{name}_tok_s"] = st.tokens / st.total_s
+                if self.peak and self.n_params and st.total_s > 0 \
+                        and st.tokens:
+                    out[f"{prefix}{name}_mfu_analytic_legacy"] = (
+                        2.0 * self.n_params * st.tokens
+                        / (st.total_s * self.peak * self.chips))
+                cost = self._costs.get(name)
+                if cost and cost["flops"] and self.peak and st.total_s > 0:
+                    out[f"{prefix}{name}_mfu"] = (
+                        cost["flops"] * st.count
+                        / (st.total_s * self.peak * self.chips))
             for name, v in self._gauges.items():
                 out[f"{prefix}{name}"] = v
         return out
@@ -243,5 +283,7 @@ def engine_profiler(cfg=None, mesh=None) -> StepProfiler | None:
         kind = getattr(d, "device_kind", d.platform)
     except Exception:
         pass
+    from localai_tpu.telemetry.sched import peak_bandwidth
+
     return StepProfiler(fence=True, n_params=n_params, peak=peak_flops(kind),
-                        mesh=shape)
+                        mesh=shape, peak_bw=peak_bandwidth(kind))

@@ -1,0 +1,376 @@
+"""Scheduler X-ray (ISSUE 13): per-tick pack ledger, fallback reason codes,
+and cost-analysis rooflines.
+
+Cheap taxonomy / ledger / roofline / benchdiff units run in tier-1; the
+engine-driving scenario streams (grammar overflow, pending admission, KV
+demotion, budget cap) are slow-marked. The load-bearing contract tested
+here: every reason code an engine site emits is REGISTERED (unregistered is
+a hard ValueError), and the dispatch-category counters sum exactly to the
+dense (non-ragged) dispatch count — the same quantity bench.py reports as
+dense_fallback_dispatches.
+"""
+import json
+import time
+
+import numpy as np
+import pytest
+
+from localai_tpu.telemetry import sched as S
+
+pytestmark = pytest.mark.tripwire
+
+TINY = dict(vocab_size=96, hidden_size=32, intermediate_size=64,
+            num_layers=2, num_heads=2, num_kv_heads=2, head_dim=16,
+            max_position=8192, dtype="float32")
+
+
+@pytest.fixture(scope="module")
+def tiny_parts():
+    import jax
+
+    from localai_tpu.models.llama import LlamaConfig, init_params
+
+    cfg = LlamaConfig(**TINY)
+    return cfg, init_params(cfg, jax.random.PRNGKey(0))
+
+
+def _engine(tiny_parts, **kw):
+    from localai_tpu.engine.engine import Engine, EngineConfig
+
+    cfg, params = tiny_parts
+    return Engine(cfg, params, None, EngineConfig(**kw))
+
+
+def _req(n=8, max_tokens=8, seed=3, **kw):
+    from localai_tpu.engine.engine import GenRequest
+    from localai_tpu.ops.sampling import SamplingParams
+
+    rng = np.random.default_rng(seed)
+    return GenRequest(rng.integers(1, 90, n).tolist(),
+                      SamplingParams(temperature=0.0),
+                      max_tokens=max_tokens, ignore_eos=True, **kw)
+
+
+def _drain(eng, steps=3000):
+    for _ in range(steps):
+        if not eng.step():
+            break
+
+
+# ------------------------------------------------------------ the taxonomy
+
+
+def test_unregistered_reason_code_hard_fails():
+    led = S.TickLedger()
+    with pytest.raises(ValueError, match="unregistered"):
+        led.reason("made_up_code")
+    # the failure leaves no counter behind
+    assert "made_up_code" not in led.counters
+
+
+def test_registry_shape_is_contractual():
+    cats = {"dispatch", "demotion", "admission", "kv", "pack"}
+    for code, (cat, desc) in S.REASON_CODES.items():
+        assert cat in cats, code
+        assert desc and code == code.lower()
+    assert set(S.DISPATCH_CODES) == {
+        c for c, (cat, _) in S.REASON_CODES.items() if cat == "dispatch"}
+    assert "loop_native" in S.DISPATCH_CODES
+    assert S.reason_category("budget_cap") == "pack"
+
+
+def test_sched_gate_and_per_engine_ledgers():
+    try:
+        S.set_sched_enabled(False)
+        assert S.maybe_ledger() is None
+        S.set_sched_enabled(True)
+        a, b = S.maybe_ledger(), S.maybe_ledger()
+        assert a is not None and b is not None and a is not b
+    finally:
+        S.set_sched_enabled(None)
+
+
+# ------------------------------------------------------------------ ledger
+
+
+def test_ledger_flat_snapshot_roundtrip():
+    led = S.TickLedger()
+    led.begin(1)
+    led.reason("pending_admission")
+    led.reason("budget_cap", kind="decode_rows")
+    led.pack("ragged", decode_rows=3, prefill_tokens=16, pad_rows=5,
+             rows_used=24, budget_rows=64, packed=19)
+    rec = led.commit(active_slots=3)
+    assert rec["tick"] == 1 and rec["active_slots"] == 3
+    assert rec["packs"][0]["variant"] == "ragged"
+    assert json.loads(json.dumps(rec))  # tick records are JSON-clean
+
+    flat = led.flat()
+    assert flat["sched_ticks_total"] == 1.0
+    assert flat["sched_reason__pending_admission"] == 1.0
+    assert flat["sched_variant__ragged"] == 1.0
+    assert flat["sched_pack__prefill_tokens"] == 16.0
+    assert flat["sched_budget_utilization"] == pytest.approx(19 / 64)
+    assert flat["sched_pad_rows_frac"] == pytest.approx(5 / 24)
+
+    snap = led.snapshot()
+    assert snap["reason_counters"]["budget_cap"] == 1
+    assert snap["recent_ticks"][-1]["tick"] == 1
+
+    led.rooflines["ragged"] = S.roofline_entry(1e6, 1e6, 1e9, 1e9)
+    led.reset()
+    # reset drops the stream but keeps the (expensive) cached rooflines
+    assert led.n_ticks == 0 and not led.counters
+    assert "ragged" in led.rooflines
+    assert "sched_roofline__ragged__flops" in led.flat()
+
+
+def test_tick_rings_wrap():
+    led = S.TickLedger(ring=8)
+    for i in range(20):
+        led.begin(i)
+        led.commit()
+    assert led.n_ticks == 20 and len(led.ticks) == 8
+    assert [r["tick"] for r in led.ticks] == list(range(12, 20))
+
+    from localai_tpu.telemetry.metrics import FlightRecorder
+
+    rec = FlightRecorder(ticks=4)
+    for i in range(10):
+        rec.record_tick({"tick": i})
+    assert [r["tick"] for r in rec.ticks] == [6, 7, 8, 9]
+
+
+def test_flightrec_events_stamp_current_tick():
+    from localai_tpu.telemetry.metrics import FlightRecorder
+
+    rec = FlightRecorder()
+    try:
+        S.set_current_tick(41)
+        rec.record_event("tripwire", detail="x")
+        S.set_current_tick(None)
+        rec.record_event("breaker_open")
+        rec.record_event("explicit", tick=7)
+    finally:
+        S.set_current_tick(None)
+    evs = list(rec.events)
+    assert evs[0]["tick"] == 41
+    assert "tick" not in evs[1]
+    assert evs[2]["tick"] == 7
+
+
+# --------------------------------------------------------------- rooflines
+
+
+def test_roofline_entry_attribution():
+    # 1 GFLOP against 1 KB on a (1 TF/s, 1 GB/s) device: compute-bound
+    e = S.roofline_entry(1e9, 1e3, 1e12, 1e9)
+    assert e["bound"] == "compute" and e["mfu"] == pytest.approx(1.0)
+    # 1 KFLOP against 1 GB: bandwidth-bound, ceiling well under 1
+    e = S.roofline_entry(1e3, 1e9, 1e12, 1e9)
+    assert e["bound"] == "bandwidth" and e["mfu"] < 1e-6
+    assert e["t_roofline_us"] == pytest.approx(e["t_memory_us"])
+    assert S.peak_bandwidth("TPU v6e") > S.peak_bandwidth("TPU v5e")
+
+
+def test_profiler_cost_backed_mfu_beside_legacy():
+    from localai_tpu.telemetry.profiler import StepProfiler
+
+    p = StepProfiler(fence=False, n_params=1000, peak=1e9, peak_bw=1e9)
+    p.record("decode", time.perf_counter() - 0.01, tokens=10)
+    r0 = p.report()["stages"]["decode"]
+    assert r0["mfu"] is None and r0["mfu_analytic_legacy"] is not None
+    p.set_costs({"decode": {"flops": 1e6, "bytes": 2e6}})
+    st = p.report()["stages"]["decode"]
+    assert st["mfu"] is not None and st["cost_flops"] == 1e6
+    flat = p.flat()
+    assert "prof_decode_mfu" in flat
+    assert "prof_decode_mfu_analytic_legacy" in flat
+
+
+# --------------------------------------------------------------- benchdiff
+
+
+def _bench_json(tmp_path, name, **fields):
+    base = {"metric": "serve tok/s (llama-tiny f32, ragged ...)",
+            "value": 100.0, "unit": "tok/s"}
+    base.update(fields)
+    p = tmp_path / name
+    p.write_text(json.dumps(base))
+    return str(p)
+
+
+def test_benchdiff_gates_ratios_not_throughput(tmp_path):
+    from tools import benchdiff
+
+    old = _bench_json(tmp_path, "old.json", ragged_over_dense=1.2,
+                      compile_count_delta=0)
+    # halved raw tok/s is box noise — NOT a regression on its own
+    ok = _bench_json(tmp_path, "ok.json", value=55.0,
+                     ragged_over_dense=1.18, compile_count_delta=0)
+    assert benchdiff.main([old, ok]) == 0
+    # a collapsed ratio metric IS a regression
+    bad = _bench_json(tmp_path, "bad.json", value=100.0,
+                      ragged_over_dense=0.6, compile_count_delta=0)
+    assert benchdiff.main([old, bad]) == 1
+    # counter invariants regress on ANY growth (new mid-stream compiles)
+    grew = _bench_json(tmp_path, "grew.json", ragged_over_dense=1.2,
+                       compile_count_delta=2)
+    assert benchdiff.main([old, grew]) == 1
+    # raw-throughput collapse past the floor fails even with ratios intact
+    dead = _bench_json(tmp_path, "dead.json", value=10.0,
+                       ragged_over_dense=1.2, compile_count_delta=0)
+    assert benchdiff.main([old, dead]) == 1
+    assert benchdiff.main([str(tmp_path / "missing.json"), ok]) == 2
+
+
+def test_benchdiff_picks_latest_two_from_runs_dir(tmp_path):
+    import os
+
+    from tools import benchdiff
+
+    for i, stamp in enumerate(["2026-01-01", "2026-01-02", "2026-01-03"]):
+        p = _bench_json(tmp_path, f"bench_{i}.json", recorded_at=stamp)
+        os.utime(p, (1000 + i, 1000 + i))
+    prev, latest = benchdiff.latest_two(str(tmp_path))
+    assert prev.endswith("bench_1.json") and latest.endswith("bench_2.json")
+    assert benchdiff.main(["--runs-dir", str(tmp_path)]) == 0
+
+
+# ------------------------------------------------- engine scenario streams
+
+
+@pytest.mark.slow
+def test_dispatch_codes_sum_to_dense_dispatches(tiny_parts):
+    """The exactness invariant behind dense_fallback_dispatches: over a
+    stream with queued admissions, EVERY dense decode dispatch emits
+    exactly one dispatch-category code — the counters sum to
+    decode_dispatches - ragged_dispatches, and the pending_admission
+    scenario (more requests than slots) appears by name."""
+    eng = _engine(tiny_parts, max_slots=2, max_context=128,
+                  prefill_buckets=(16,), prompt_cache=False,
+                  decode_loop=4)
+    assert eng._sched is not None
+    # staggered budgets + a 4-step loop window: the short request frees its
+    # slot at a loop boundary while its neighbour still decodes, so the
+    # next dispatch sees free-slot + queued request simultaneously
+    # (_dispatch runs before _prefill_tick each tick) and must fall back
+    # dense with the pending_admission code
+    for i in range(5):   # 5 requests through 2 slots → queued admissions
+        eng.submit(_req(seed=i, max_tokens=4 if i % 2 == 0 else 20))
+    _drain(eng)
+    sched = eng._sched
+    dense = eng.metrics["decode_dispatches"] - \
+        eng.metrics.get("ragged_dispatches", 0)
+    code_sum = sum(sched.counters.get(c, 0) for c in S.DISPATCH_CODES)
+    assert dense > 0 and code_sum == dense, dict(sched.counters)
+    assert sched.counters.get("pending_admission", 0) > 0
+    # ledger <-> metrics cross-checks on the same stream
+    assert sched.n_ticks > 0
+    assert sched.n_dispatches == sum(sched.variants.values())
+    assert sum(v for k, v in eng.metrics.items()
+               if k.startswith("tokens_by_path__")) == \
+        eng.metrics["tokens_generated"]
+    flat = sched.flat()
+    assert flat["sched_ticks_total"] == float(sched.n_ticks)
+    # tick records reached the flight recorder ring with full pack detail
+    if eng._flightrec is not None:
+        recs = [r for r in eng._flightrec.ticks if "packs" in r]
+        assert recs and any(r["packs"] for r in recs)
+
+
+@pytest.mark.slow
+def test_budget_cap_reason_under_tiny_ragged_budget(tiny_parts):
+    """A 16-row token budget holds ONE decode q-block (cap = T - QBLK):
+    three concurrent decodes must trip the decode_rows budget cap, and the
+    ragged pack must report meaningful budget utilization."""
+    eng = _engine(tiny_parts, max_slots=3, max_context=128,
+                  prefill_buckets=(16,), prefill_chunk=16, kv_pages=16,
+                  prompt_cache=False, ragged_token_budget=16)
+    for i in range(3):
+        eng.submit(_req(seed=10 + i, max_tokens=6))
+    _drain(eng)
+    sched = eng._sched
+    assert sched.counters.get("budget_cap", 0) > 0, dict(sched.counters)
+    assert eng.metrics["ragged_dispatches"] > 0
+    assert 0.0 < sched.budget_utilization() <= 1.0
+    assert eng.metrics["budget_utilization"] > 0.0
+    # the committed tick records carry the machine-readable kind field
+    kinds = {r.get("kind") for rec in sched.ticks
+             for r in rec["reasons"] if isinstance(r, dict)}
+    assert "decode_rows" in kinds
+
+
+@pytest.mark.slow
+def test_kv_policy_demotion_reason_matches_metric(tiny_parts):
+    """A full-attention request too big for the compact windowed pool is
+    demoted at admission: the engine metric and the reason-code counter
+    move in lockstep."""
+    eng = _engine(tiny_parts, max_slots=1, max_context=4096,
+                  prefill_buckets=(16,), kv_pages=24,
+                  kv_policy="sink_window(sinks=256, window=512)")
+    eng.submit(_req(n=39, max_tokens=3900, kv_policy="full"))
+    for _ in range(30):
+        eng.step()
+    assert eng.metrics["kv_policy_demotions"] >= 1
+    assert eng._sched.counters.get("kv_policy_demotion", 0) == \
+        eng.metrics["kv_policy_demotions"]
+
+
+@pytest.mark.slow
+def test_grammar_overflow_reason_and_hostonly_dispatches(tmp_path_factory):
+    """A 1-state table cap overflows on any real grammar: the admission
+    emits grammar_table_overflow, and every dense dispatch while that slot
+    lives carries the grammar_hostonly dispatch code."""
+    from fixtures import tiny_checkpoint
+    from localai_tpu.engine import (
+        Engine, EngineConfig, GenRequest, Tokenizer, load_config,
+        load_params,
+    )
+    from localai_tpu.functions.grammars import json_schema_grammar
+    from localai_tpu.ops.sampling import SamplingParams
+
+    ckpt = tiny_checkpoint(tmp_path_factory)
+    cfg = load_config(ckpt, dtype="float32")
+    params = load_params(ckpt, cfg)
+    tok = Tokenizer.from_dir(ckpt)
+    eng = Engine(cfg, params, tok, EngineConfig(
+        max_slots=2, max_context=128, prefill_buckets=(16,),
+        prompt_cache=False, grammar_table_states=1))
+    schema = {"type": "object",
+              "properties": {"a": {"type": "integer"}}, "required": ["a"]}
+    eng.submit(GenRequest(tok.encode("emit json:"),
+                          SamplingParams(temperature=0.0), max_tokens=12,
+                          grammar=json_schema_grammar(schema)))
+    _drain(eng)
+    sched = eng._sched
+    assert sched.counters.get("grammar_table_overflow", 0) >= 1
+    assert sched.counters.get("grammar_hostonly", 0) > 0
+    assert eng.metrics.get("grammar_table_overflows", 0) >= 1
+
+
+@pytest.mark.slow
+def test_rooflines_cost_variants_without_new_compiles(tiny_parts):
+    """engine.rooflines() AOT-costs every dispatched variant (real XLA
+    cost_analysis FLOPs/bytes) and must not add jit-cache compiles — the
+    compile-count tripwire quantity stays frozen."""
+    from localai_tpu.testing.tripwires import decode_compile_count
+
+    eng = _engine(tiny_parts, max_slots=2, max_context=128,
+                  prefill_buckets=(16,), prompt_cache=False)
+    for i in range(2):
+        eng.submit(_req(seed=20 + i))
+    _drain(eng)
+    before = decode_compile_count(eng)
+    roofs = eng.rooflines(force=True)
+    assert roofs, "no variant was costed"
+    for name, e in roofs.items():
+        assert e["cost_flops"] > 0 and e["cost_bytes"] > 0, name
+        assert e["bound"] in ("compute", "bandwidth")
+        assert 0.0 < e["mfu"] <= 1.0
+    assert decode_compile_count(eng) == before
+    # costed variant names match the dispatched-variant ledger names
+    assert set(roofs) <= set(eng._sched.variants) | set(roofs)
+    snap = eng.sched_snapshot()
+    assert snap["rooflines"] and snap["recent_ticks"]
+    assert set(snap["rooflines"]) == set(roofs)

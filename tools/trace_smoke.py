@@ -127,6 +127,27 @@ def main() -> int:
     print(f"SLO: ttft_p50={metrics['ttft_ms_p50']:.1f}ms "
           f"ttft_p95={metrics.get('ttft_ms_p95', 0):.1f}ms "
           f"flightrec={len(rec_ids)} timelines")
+
+    # scheduler X-ray (ISSUE 13): the tick ledger must cross the scrape
+    # boundary — sched_* keys in GetMetrics, the structured snapshot (with
+    # the served ticks and at least one reason-code counter) in GetTrace
+    if not metrics.get("sched_ticks_total", 0) > 0:
+        print("FAIL: GetMetrics carries no sched_ticks_total", file=sys.stderr)
+        return 1
+    if not any(k.startswith("sched_reason__") for k in metrics):
+        print("FAIL: GetMetrics carries no sched_reason__* keys",
+              file=sys.stderr)
+        return 1
+    sched = payload.get("sched") or {}
+    if sched.get("ticks_total", 0) <= 0 or not sched.get("reason_counters"):
+        print(f"FAIL: GetTrace sched snapshot incomplete "
+              f"({sorted(sched.keys())})", file=sys.stderr)
+        return 1
+    if not sched.get("recent_ticks"):
+        print("FAIL: sched snapshot carries no tick records", file=sys.stderr)
+        return 1
+    print(f"sched: {sched['ticks_total']} ticks, "
+          f"reasons={sched['reason_counters']}")
     print("trace smoke OK")
     return 0
 
