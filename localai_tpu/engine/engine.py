@@ -127,6 +127,29 @@ class EngineConfig:
                                   # feature rows via per-row embedding
                                   # injection — neither forces a dense
                                   # fallback dispatch.
+    ragged_loop_steps: int = 16   # fused multi-step ragged ticks (ragged
+                                  # engines only): up to this many decode
+                                  # iterations per ragged dispatch in ONE
+                                  # on-device lax.while_loop
+                                  # (models/llama.build_ragged_loop).
+                                  # Iteration 0 is the mixed ragged pack;
+                                  # follow-on iterations re-derive the
+                                  # decode metadata on device and run the
+                                  # dense decode body, early-exiting when
+                                  # any slot finishes (the host admits into
+                                  # the freed slot immediately), when the
+                                  # host-set prefill-pending flag is up
+                                  # (TTFT stays at ragged levels), or at
+                                  # this step cap. Pure-decode ticks on a
+                                  # ragged engine ride the same program
+                                  # (pack-free variant) instead of the
+                                  # decode_loop path, gaining the
+                                  # first-finish exit. 0/1 disables — the
+                                  # engine keeps the single-step ragged +
+                                  # decode_loop split (the escape hatch).
+                                  # Speculative (draft) engines ignore it:
+                                  # spec-as-ragged verify windows stay
+                                  # single-step per tick.
     grammar_table_states: int = 256  # device grammar tables: shared capacity
                                   # (automaton states across live grammars)
                                   # for the precompiled [S, ceil(V/32)] u32
@@ -509,6 +532,7 @@ class Engine:
             # servers can compute constrained_over_plain-style ratios from
             # GetMetrics, not just bench.py --mode soup
             "tokens_by_path__loop": 0,
+            "tokens_by_path__rloop": 0,
             "tokens_by_path__ragged": 0,
             "tokens_by_path__spec": 0,
             "tokens_by_path__dense": 0,
@@ -524,6 +548,12 @@ class Engine:
             self.metrics["ragged_dispatches"] = 0
             self.metrics["ragged_tokens_packed"] = 0
             self.metrics["budget_utilization"] = 0.0
+            # dispatch-budget bookkeeping (ISSUE 16): prefill tokens that
+            # rode ragged packs (they earn budget credit alongside generated
+            # tokens) and spec-as-ragged dispatches (still exempt — see
+            # testing/tripwires.dispatch_budget)
+            self.metrics["ragged_prefill_tokens"] = 0
+            self.metrics["spec_ragged_dispatches"] = 0
         # per-request path attribution (bench.py --mode soup): opt-in so the
         # dict can't grow unbounded under a long-lived server
         self.record_paths = False
@@ -1038,6 +1068,7 @@ class Engine:
         # tokens as any fast-width tier (ops/sampling._draw is width-
         # independent), so ragged and dense serving emit identical streams.
         self._ragged_fn = None
+        self._ragged_loop_fn = None
         if self._ragged:
             from localai_tpu.models.llama import ragged_forward
 
@@ -1076,6 +1107,34 @@ class Engine:
 
             self._ragged_fn = jax.jit(_ragged_step,
                                       donate_argnums=(3, 4, 5, 6, 7))
+
+            # fused multi-step ragged tick (ISSUE 16): iteration 0 is the
+            # mixed ragged body above, follow-on iterations re-derive the
+            # decode metadata on device and run the raw dense body — one
+            # dispatch covers up to ragged_loop_steps decode steps with
+            # first-finish / prefill-pending early exit
+            # (models/llama.build_ragged_loop). Draft engines keep the
+            # spec-as-ragged single-step tick: verify windows are whole
+            # rows of the pack and must return to the host every tick.
+            if self.ec.ragged_loop_steps > 1 and self._draft is None:
+                from localai_tpu.models.llama import build_ragged_loop
+
+                _rloop_raw = build_ragged_loop(
+                    _ragged_step, _decode_raw,
+                    max_steps=self.ec.ragged_loop_steps,
+                    limit=self.ec.max_context - 2 - self._ctx_reserve)
+
+                def _rloop(*a, **kw):
+                    (toks, lps, n_out, steps, code, kc, vc, sampler,
+                     last_logits, lengths) = _rloop_raw(*a, **kw)
+                    return (constrain(toks, P(None, None)),
+                            constrain(lps, P(None, None)),
+                            constrain(n_out, P(None)), steps, code,
+                            kc, vc, sampler, last_logits, lengths)
+
+                self._ragged_loop_fn = jax.jit(
+                    _rloop, donate_argnums=(3, 4, 5, 6, 7),
+                    static_argnames=("fast_width", "has_pack"))
 
         # cold demotion: copy ONE hot physical block into a cold-pool index
         # with sub-channel (per-token over head_dim) int8 quantization.
@@ -1479,6 +1538,13 @@ class Engine:
         self.metrics["ragged_tokens_packed"] = (
             self.metrics.get("ragged_tokens_packed", 0)
             + int(pack["packed"]))
+        # non-decode rows actually packed (prefill-chunk tokens): the
+        # dispatch-budget tripwire credits these against the per-token
+        # budget, so mixed consolidation stays exempt-by-math while
+        # decode-heavy single-step ragged streams count at full price
+        self.metrics["ragged_prefill_tokens"] = (
+            self.metrics.get("ragged_prefill_tokens", 0)
+            + int(pack["packed"]) - int(np.sum(pack["is_decode"])))
         self.metrics["budget_utilization"] = (
             self.metrics["ragged_tokens_packed"]
             / max(self.metrics["ragged_dispatches"] * self._ragged_rows, 1))
@@ -1519,6 +1585,116 @@ class Engine:
                   grammar=pack.get("mask") is not None)
         return _AsyncFetch((tokens, logprobs))
 
+    def _dev_ragged_loop(self, pack, remaining, check_eos, prefill_pending,
+                         gstate=None):
+        """ONE fused multi-step ragged dispatch (ISSUE 16): the mixed pack
+        runs as iteration 0, then up to ragged_loop_steps-1 dense decode
+        iterations continue every live decode slot on device
+        (models/llama.build_ragged_loop). `remaining`/`check_eos` [B] are
+        the PR 6 per-slot stop inputs; `prefill_pending` (traced bool) makes
+        the loop collapse to a single iteration when the host has prefill or
+        admission work, so TTFT stays at single-step ragged levels. Steps
+        actually run and the exit code ride the async fetch — step and
+        exit-reason metrics are credited at consume time."""
+        self.metrics["decode_dispatches"] += 1
+        self.metrics["ragged_dispatches"] = (
+            self.metrics.get("ragged_dispatches", 0) + 1)
+        self.metrics["ragged_tokens_packed"] = (
+            self.metrics.get("ragged_tokens_packed", 0)
+            + int(pack["packed"]))
+        n_dec = int(np.sum(pack["is_decode"]))
+        self.metrics["ragged_prefill_tokens"] = (
+            self.metrics.get("ragged_prefill_tokens", 0)
+            + int(pack["packed"]) - n_dec)
+        self.metrics["budget_utilization"] = (
+            self.metrics["ragged_tokens_packed"]
+            / max(self.metrics["ragged_dispatches"] * self._ragged_rows, 1))
+        t0 = time.perf_counter()
+        self._bcast("ragged_loop", remaining=remaining, check_eos=check_eos,
+                    prefill_pending=bool(prefill_pending), gstate=gstate,
+                    **pack)
+        with activate_mesh(self.mesh), self._decode_guard():
+            gkw = {}
+            if gstate is not None:
+                gmasks, gtrans = self._gtab()
+                gkw = dict(gstate=jnp.asarray(np.asarray(gstate, np.int32)),
+                           gmasks=gmasks, gtrans=gtrans)
+            variant = ("rloop_pack"
+                       + ("_grammar" if gstate is not None else ""))
+            dev_pack = dict(
+                tokens=jnp.asarray(pack["tokens"]),
+                decode_slot=jnp.asarray(pack["decode_slot"]),
+                set_len=jnp.asarray(pack["set_len"]),
+                logit_set=jnp.asarray(pack["logit_set"]),
+                logit_rows=jnp.asarray(pack["logit_rows"]),
+                block_seq=jnp.asarray(pack["block_seq"]),
+                qstart=jnp.asarray(pack["qstart"]),
+                qlen=jnp.asarray(pack["qlen"]),
+                kvlen=jnp.asarray(pack["kvlen"]))
+            fargs = (self.params, self._cos, self._sin, self._kc, self._vc,
+                     self._sampler, self._last_logits, self._lengths,
+                     jnp.asarray(pack["is_decode"]),
+                     jnp.asarray(remaining), jnp.asarray(check_eos),
+                     self._eos_dev, jnp.asarray(bool(prefill_pending)))
+            fkw = dict(pack=dev_pack, table=self._tab(), kvt=self._kvt(),
+                       fast_width=None, has_pack=True, **gkw)
+            rows = int(pack.get("rows_used", 0))
+            self._sched_pack(
+                variant, self._ragged_loop_fn, fargs, fkw,
+                decode_rows=n_dec,
+                prefill_tokens=int(pack["packed"]) - n_dec,
+                pad_rows=max(rows - int(pack["packed"]), 0),
+                rows_used=rows, budget_rows=self._ragged_rows,
+                packed=int(pack["packed"]))
+            (toks, lps, n_out, steps, code, self._kc, self._vc,
+             self._sampler, self._last_logits,
+             self._lengths) = self._ragged_loop_fn(*fargs, **fkw)
+        self._obs("ragged_loop", t0, tokens=int(pack["packed"]), fence=toks,
+                  grammar=gstate is not None)
+        return _AsyncFetch((toks, lps, n_out, steps, code))
+
+    def _dev_rloop_decode(self, active, remaining, check_eos,
+                          fast_width=None, gstate=None):
+        """The fused ragged loop's pack-free variant: a pure-decode tick on
+        a ragged engine. Same stop conditions and grammar-table handling as
+        _dev_decode_loop, plus the first-finish early exit — one finished
+        slot returns control to the host so the freed slot admits
+        immediately instead of waiting out the remaining steps."""
+        self.metrics["decode_dispatches"] += 1
+        t0 = time.perf_counter()
+        self._bcast("rloop_decode", active=active, remaining=remaining,
+                    check_eos=check_eos, fast_width=fast_width,
+                    gstate=gstate)
+        with activate_mesh(self.mesh), self._decode_guard():
+            gkw = {}
+            if gstate is not None:
+                gmasks, gtrans = self._gtab()
+                gkw = dict(gstate=jnp.asarray(np.asarray(gstate, np.int32)),
+                           gmasks=gmasks, gtrans=gtrans)
+            variant = ("rloop" + (f"_fast{fast_width}" if fast_width else "")
+                       + ("_grammar" if gstate is not None else ""))
+            fargs = (self.params, self._cos, self._sin, self._kc, self._vc,
+                     self._sampler, self._last_logits, self._lengths,
+                     jnp.asarray(active), jnp.asarray(remaining),
+                     jnp.asarray(check_eos), self._eos_dev,
+                     jnp.asarray(False))
+            fkw = dict(pack=None, table=self._tab(), kvt=self._kvt(),
+                       fast_width=fast_width, has_pack=False, **gkw)
+            n_act = int(np.sum(active))
+            B = self.ec.max_slots
+            self._sched_pack(variant, self._ragged_loop_fn, fargs, fkw,
+                             decode_rows=n_act, rows_used=B,
+                             pad_rows=B - n_act, packed=n_act)
+            (toks, lps, n_out, steps, code, self._kc, self._vc,
+             self._sampler, self._last_logits,
+             self._lengths) = self._ragged_loop_fn(*fargs, **fkw)
+        self._obs("rloop_decode", t0,
+                  tokens=int(np.minimum(np.maximum(remaining, 0),
+                                        self.ec.ragged_loop_steps).sum()),
+                  fence=toks, fast_width=fast_width or 0,
+                  grammar=gstate is not None)
+        return _AsyncFetch((toks, lps, n_out, steps, code))
+
     def _dev_spec_ragged(self, pack):
         """ONE spec-as-ragged dispatch: gamma draft steps + a ragged target
         verify covering every verifying slot's (gamma+1)-row window PLUS any
@@ -1530,6 +1706,11 @@ class Engine:
         self.metrics["decode_steps_dispatched"] += self.ec.gamma + 1
         self.metrics["ragged_dispatches"] = (
             self.metrics.get("ragged_dispatches", 0) + 1)
+        # spec dispatches keep the dispatch-budget exemption (gamma-fused by
+        # construction; acceptance is gated separately) — the tripwire
+        # subtracts this counter, not ragged_dispatches
+        self.metrics["spec_ragged_dispatches"] = (
+            self.metrics.get("spec_ragged_dispatches", 0) + 1)
         self.metrics["ragged_tokens_packed"] = (
             self.metrics.get("ragged_tokens_packed", 0)
             + int(pack["packed"]))
@@ -1743,6 +1924,16 @@ class Engine:
                                   kw.get("gstate"))
         elif op == "ragged":
             self._dev_ragged(dict(kw, inject=self._inj_of(kw.get("inject"))))
+        elif op == "ragged_loop":
+            kw = dict(kw)
+            self._dev_ragged_loop(kw, kw.pop("remaining"),
+                                  kw.pop("check_eos"),
+                                  kw.pop("prefill_pending"),
+                                  gstate=kw.pop("gstate"))
+        elif op == "rloop_decode":
+            self._dev_rloop_decode(kw["active"], kw["remaining"],
+                                   kw["check_eos"], kw.get("fast_width"),
+                                   kw.get("gstate"))
         elif op == "spec_ragged":
             self._dev_spec_ragged(
                 dict(kw, inject=self._inj_of(kw.get("inject"))))
@@ -2399,7 +2590,8 @@ class Engine:
         loop blocks can pipeline without ever overshooting a budget; a slot
         whose whole budget is already in flight sits this dispatch out (the
         device would run it zero steps anyway)."""
-        G = self.ec.decode_loop
+        G = (self.ec.ragged_loop_steps if self._ragged_loop_fn is not None
+             else self.ec.decode_loop)
         B = self.ec.max_slots
         remaining = np.zeros((B,), np.int32)
         check_eos = np.zeros((B,), bool)
@@ -2425,9 +2617,18 @@ class Engine:
             # stay exhaustive over dense dispatches (the fallback-sum
             # invariant bench.py's dense_fallback_reasons relies on)
             self._sched.reason("loop_native")
-        fetch = self._dev_decode_loop(
-            active, remaining, check_eos, fast,
-            gstate=self._gstate.copy() if self._grammar_slots > 0 else None)
+        gstate = self._gstate.copy() if self._grammar_slots > 0 else None
+        if self._ragged_loop_fn is not None:
+            # ragged engines with the fused loop: pure-decode dispatches
+            # ride the pack-free ragged-loop variant — same stop semantics
+            # as the decode_loop program plus the first-finish early exit
+            # (a freed slot admits immediately instead of waiting out the
+            # loop; G above already capped reservations at its step budget)
+            fetch = self._dev_rloop_decode(active, remaining, check_eos,
+                                           fast, gstate=gstate)
+            return ("rloop", fetch, live, res)
+        fetch = self._dev_decode_loop(active, remaining, check_eos, fast,
+                                      gstate=gstate)
         return ("loop", fetch, live, res)
 
     def _dispatch(self):
@@ -2497,6 +2698,27 @@ class Engine:
             host_sync_wait_ms_per_token=(
                 m["host_sync_wait_ms"] / max(m["tokens_generated"], 1)))
 
+    # device exit codes of the fused ragged loop (models/llama.py
+    # RLOOP_EXIT_*) → telemetry.sched pack reason codes. host_arbitration is
+    # recorded host-side at decline time (_ragged_tick), never by the device.
+    _RLOOP_EXIT_REASON = {
+        0: "loop_early_exit_steps_cap",
+        1: "loop_early_exit_finish",
+        2: "loop_early_exit_prefill",
+    }
+
+    def _rloop_exit(self, code: int, reason: str | None = None) -> None:
+        """Record one fused-ragged-loop exit: the sched pack reason code
+        (per-tick attribution) plus a flat metrics counter
+        (`rloop_exit_<cause>`) the bench JSON reports as
+        loop_exit_reasons."""
+        reason = reason or self._RLOOP_EXIT_REASON.get(
+            code, "loop_early_exit_steps_cap")
+        if self._sched is not None:
+            self._sched.reason(reason)
+        key = "rloop_exit_" + reason[len("loop_early_exit_"):]
+        self.metrics[key] = self.metrics.get(key, 0) + 1
+
     def _consume_loop(self, pend):
         """Consume a fused while-loop dispatch: finish the async token fetch,
         credit the ACTUAL step count (early exit makes it <= decode_loop),
@@ -2504,10 +2726,18 @@ class Engine:
         re-derives every finish decision in _emit — cancel/deadline can
         terminate a slot mid-buffer, and the rest of its tokens are dropped
         by the request-id check exactly as on the block path."""
-        _, fetch, entries, res = pend
+        tag, fetch, entries, res = pend
         t0 = time.perf_counter()
-        tokens, logprobs, n_out, steps = fetch.wait()
+        out = fetch.wait()
         self.metrics["host_sync_wait_ms"] += (time.perf_counter() - t0) * 1e3
+        if tag == "rloop":
+            # fused ragged loop (pack-free variant): the fetch carries the
+            # device's exit code — map it onto the pack reason taxonomy and
+            # the flat loop-exit counters the bench scoreboard reads
+            tokens, logprobs, n_out, steps, code = out
+            self._rloop_exit(int(code))
+        else:
+            tokens, logprobs, n_out, steps = out
         steps = int(steps)
         self.metrics["decode_steps_dispatched"] += steps
         self._release_reservations(entries, res)
@@ -2526,7 +2756,8 @@ class Engine:
                 if slot is None or slot.request_id != rid:
                     continue  # finished earlier (cancel/deadline/shift race)
                 self._emit(i, slot, int(tokens[g, i]),
-                           float(logprobs[g, i]), now, path="loop")
+                           float(logprobs[g, i]), now,
+                           path="rloop" if tag == "rloop" else "loop")
                 emitted += 1
         self._obs("sample", t0, tokens=emitted, steps=steps, rollbacks=0)
         self._dispatch_gauges()
@@ -2538,7 +2769,7 @@ class Engine:
         their block-START mask: the first token a slot's (live) PDA rejects
         marks that slot for rollback — its accepted prefix stands, the rest of
         its block is discarded, and _repair restores the device state."""
-        if pend[0] == "loop":
+        if pend[0] in ("loop", "rloop"):
             self._consume_loop(pend)
             return
         _, fetch, entries, gmask, res = pend
@@ -2947,7 +3178,51 @@ class Engine:
                           if self._grammar_slots > 0 else None),
                     inject=(None if inj_extra is None
                             else (inj_extra, inj_mask)))
-        fetch = self._dev_ragged(pack)
+        # fused multi-step tick (ISSUE 16): run the pack as iteration 0 of
+        # the ragged loop and let every decode slot keep advancing on device
+        # until a slot finishes, host work appears, or the step cap. Host
+        # arbitration declines the loop: host-only grammar overflows and
+        # stop-string slots need per-token host decisions, and mm inject
+        # rows only occur mid-prefill where the loop would cap at one step
+        # anyway — all three keep the single-step dispatch (exact current
+        # behavior, fresh host masks).
+        res: dict[int, int] = {}
+        arbitration = (self._grammar_hostonly > 0
+                       or any(self._slots[i] is not None
+                              and self._slots[i].req.stop
+                              for i, _ in entries))
+        use_loop = (self._ragged_loop_fn is not None and bool(entries)
+                    and inj_extra is None and not arbitration)
+        if use_loop:
+            remaining = np.zeros((B,), np.int32)
+            check_eos = np.zeros((B,), bool)
+            for i, rid in entries:
+                s = self._slots[i]
+                remaining[i] = max(1, s.req.max_tokens - s.generated
+                                   - s.inflight)
+                check_eos[i] = self.tok is not None and not s.req.ignore_eos
+                # pipelined-style budget reservation (PR 6): released at
+                # consume below, before emission moves tokens to `generated`
+                res[i] = int(min(self.ec.ragged_loop_steps, remaining[i]))
+                s.inflight += res[i]
+            # prefill-pending flag, computed at dispatch time: chunk work
+            # left after this pack (mid chunks, budget-capped slots),
+            # queued/deferred admissions — any of these collapses the loop
+            # to a single iteration so TTFT stays at ragged levels
+            left = set(self._prefillq) - {
+                idx for idx, _pos, _nv, fin in chunks if fin}
+            prefill_pending = (bool(left) or self._deferred is not None
+                               or not self._queue.empty())
+            fetch = self._dev_ragged_loop(
+                pack, remaining, check_eos, prefill_pending,
+                gstate=(self._gstate.copy()
+                        if self._grammar_slots > 0 else None))
+        else:
+            if (self._ragged_loop_fn is not None and entries
+                    and arbitration):
+                self._rloop_exit(-1,
+                                 reason="loop_early_exit_host_arbitration")
+            fetch = self._dev_ragged(pack)
         for idx, pos, nvalid, final in chunks:
             s = self._slots[idx]
             s.prefill_pos = pos + nvalid
@@ -2962,7 +3237,15 @@ class Engine:
                     self._slo.observe("prefill", "all",
                                       s.prefill_done_t - s.start_time)
         t0 = time.perf_counter()
-        tokens_out, logprobs = fetch.wait()
+        steps = 1
+        if use_loop:
+            tokens_out, logprobs, n_out, steps, code = fetch.wait()
+            steps = int(steps)
+            self.metrics["decode_steps_dispatched"] += steps
+            self._rloop_exit(int(code))
+            self._release_reservations(entries, res)
+        else:
+            tokens_out, logprobs = fetch.wait()
         self.metrics["host_sync_wait_ms"] += (time.perf_counter() - t0) * 1e3
         now = time.monotonic()
         if self._slo is not None:
@@ -2978,14 +3261,29 @@ class Engine:
                     s.dispatches += 1
                     s.path = "ragged"
         emitted = 0
-        for i, rid in entries:
-            s = self._slots[i]
-            if s is None or s.request_id != rid:
-                continue
-            self._emit(i, s, int(tokens_out[i]), float(logprobs[i]), now,
-                       path="ragged")
-            emitted += 1
-        self._obs("sample", t0, tokens=emitted, steps=1, rollbacks=0)
+        if use_loop:
+            # drain the [steps, B] device token ring in device order — the
+            # host re-derives every finish decision in _emit exactly as on
+            # the loop path (cancel/deadline can drop a slot mid-ring)
+            for g in range(steps):
+                for i, rid in entries:
+                    if g >= int(n_out[i]):
+                        continue
+                    s = self._slots[i]
+                    if s is None or s.request_id != rid:
+                        continue
+                    self._emit(i, s, int(tokens_out[g, i]),
+                               float(logprobs[g, i]), now, path="ragged")
+                    emitted += 1
+        else:
+            for i, rid in entries:
+                s = self._slots[i]
+                if s is None or s.request_id != rid:
+                    continue
+                self._emit(i, s, int(tokens_out[i]), float(logprobs[i]),
+                           now, path="ragged")
+                emitted += 1
+        self._obs("sample", t0, tokens=emitted, steps=steps, rollbacks=0)
         self._dispatch_gauges()
 
     def _kv_tick(self):
@@ -3737,7 +4035,8 @@ class Engine:
             "decode_dispatches", "decode_steps_dispatched",
             "host_sync_wait_ms") + (
             ("ragged_dispatches", "ragged_tokens_packed",
-             "budget_utilization")
+             "budget_utilization", "ragged_prefill_tokens",
+             "spec_ragged_dispatches")
             if self._ragged else ())}
         idle = np.zeros((B,), bool)
         ones_mask = np.full((B, self._mask_nbytes), 0xFF, np.uint8)
@@ -3803,6 +4102,21 @@ class Engine:
                            dict(base, inject=inj),
                            dict(base, mask=ones_mask, inject=inj)):
                     self._dev_ragged(pk).wait()
+                if self._ragged_loop_fn is not None:
+                    # fused multi-step pack variants (ISSUE 16): the loop
+                    # program is one trace per grammar-table presence —
+                    # prefill_pending/remaining are traced runtime values,
+                    # so one all-dead dispatch covers every future mix
+                    lp = {k: v for k, v in base.items()
+                          if k not in ("mask", "inject")}
+                    self._dev_ragged_loop(
+                        dict(lp), np.zeros((B,), np.int32),
+                        np.zeros((B,), bool), False).wait()
+                    if idle_gstate is not None:
+                        self._dev_ragged_loop(
+                            dict(lp), np.zeros((B,), np.int32),
+                            np.zeros((B,), bool), False,
+                            gstate=idle_gstate).wait()
             widths = [None]
             W = self.ec.sampling_topk_width
             if W:
@@ -3810,12 +4124,24 @@ class Engine:
                 if min(8 * W, V) != min(W, V):
                     widths.append(min(8 * W, V))   # the escalation tier
             for w in widths:
-                if self._decode_loop_fn is not None:
+                if self._ragged_loop_fn is not None:
+                    # fused-ragged engines dispatch the loop's pack-free
+                    # variant for pure-decode ticks; _dev_decode_loop never
+                    # runs there, so warming it would be a wasted compile
+                    self._dev_rloop_decode(
+                        idle, np.zeros((B,), np.int32),
+                        np.zeros((B,), bool), w).wait()
+                elif self._decode_loop_fn is not None:
                     self._dev_decode_loop(
                         idle, np.zeros((B,), np.int32),
                         np.zeros((B,), bool), w).wait()
                 self._dev_decode(idle, None, w).wait()
-            if self._decode_loop_fn is not None and idle_gstate is not None:
+            if self._ragged_loop_fn is not None and idle_gstate is not None:
+                self._dev_rloop_decode(idle, np.zeros((B,), np.int32),
+                                       np.zeros((B,), bool), None,
+                                       gstate=idle_gstate).wait()
+            elif (self._decode_loop_fn is not None
+                    and idle_gstate is not None):
                 # the grammar-table loop variant (full-sort sampling only —
                 # masked slots never ride a fast_width tier)
                 self._dev_decode_loop(idle, np.zeros((B,), np.int32),

@@ -26,6 +26,18 @@ penalties — with token counts frozen at window start (the same
 approximation llama.cpp's spec sampler makes). The draft proposes from a
 temperature-only distribution; any proposal is distribution-safe under the
 accept/residual rule.
+
+Fused multi-step ragged ticks (ISSUE 16) and spec: verify windows stay
+SINGLE-step. A spec tick already amortizes the dispatch boundary over
+gamma+1 tokens per slot, and the accept/rollback arbitration after each
+window is inherently host-side (acceptance counts feed gamma autotuning and
+per-request rollback bookkeeping), so draft engines never build
+`_ragged_loop_fn` — the engine gates the fused loop on `self._draft is
+None` in `_build_jit`. Were a future PR to fold verify windows into the
+device loop, acceptance would have to become a loop-carried reduction and
+any rejection would force the `loop_early_exit_host_arbitration` exit; the
+spec-as-ragged pack layout (gamma+1 rows per verifying slot) already fits
+the loop's ragged iteration, so only the arbitration move is open.
 """
 from __future__ import annotations
 

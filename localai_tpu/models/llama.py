@@ -1069,6 +1069,146 @@ def build_decode_loop(step_fn, *, max_steps: int, limit: int):
     return decode_loop
 
 
+# fused ragged-loop exit codes (device → host; engine maps them onto the
+# telemetry.sched pack reason codes at consume time)
+RLOOP_EXIT_STEPS_CAP = 0   # ran the full max_steps budget
+RLOOP_EXIT_FINISH = 1      # a decode slot finished (EOS/max_tokens/context)
+RLOOP_EXIT_PREFILL = 2     # host-set prefill/admission-pending flag
+
+
+def build_ragged_loop(ragged_step, decode_step, *, max_steps: int,
+                      limit: int):
+    """Fused multi-step ragged tick (Kernel Looping over the ragged pack):
+    the mixed ragged dispatch plus up to `max_steps - 1` follow-on decode
+    iterations run as ONE device program, so every live decode slot keeps
+    advancing without a host round trip per token.
+
+    The re-pack between iterations degenerates to pure data movement on
+    device: iteration 0 runs `ragged_step` (the engine's single-step mixed
+    body — sample, splice into the flat stream, one ragged_forward over
+    decode rows + prefill chunks, set_len/logit_set commits), after which
+    every datum the next decode step needs (lengths, last_logits, sampler
+    state, block tables, grammar `gstate`) is already device-resident.
+    Iterations >= 1 therefore run `decode_step` (the SAME fused
+    sample→decode body the dense while loop uses) over the decode-live
+    slots — a [B]-row step, not a re-run of the [T]-row ragged forward, so
+    a multi-step dispatch costs ragged + (steps-1) x dense instead of
+    steps x ragged. Slots mid-prefill (or whose final chunk just packed,
+    sampler row pending host install) sit the continuation out frozen.
+
+    With `has_pack=False` the ragged iteration is skipped entirely and the
+    program is the pure-decode loop for ragged engines: `build_decode_loop`
+    semantics plus the early-exit conditions below. Per-slot RNG streams are
+    bit-identical to the single-step paths either way (`_draw` is width-
+    independent and finished slots freeze key/last_logits exactly as the
+    dense loop does).
+
+    The loop EARLY-EXITS (cond, evaluated per iteration) when:
+    - any decode slot finishes (EOS set / `remaining` budget / `limit`
+      context margin — the PR 6 stop conditions): the host can admit into
+      the freed slot immediately instead of waiting out the step cap;
+    - `prefill_pending` (a traced bool shipped per dispatch) says the host
+      has prefill chunks or admissible queue work: the dispatch collapses
+      to a single iteration so TTFT stays at ragged levels;
+    - the `max_steps` budget is spent.
+    Host-arbitration cases (host-only grammar masks, stop strings) never
+    reach this program — the engine falls back to the single-step ragged
+    dispatch and records `loop_early_exit_host_arbitration`.
+
+    Returns (toks [max_steps, B], lps [max_steps, B], n_out [B], steps_run,
+    exit_code, kc, vc, sampler, last_logits, lengths); slot b's valid
+    tokens are ring rows 0..n_out[b)-1 and exit_code is one of the
+    RLOOP_EXIT_* constants (finish wins over prefill wins over steps_cap).
+    """
+
+    def ragged_loop(params, cos, sin, kc, vc, sampler, last_logits, lengths,
+                    is_decode, remaining, check_eos, eos_ids,
+                    prefill_pending, pack=None, table=None, kvt=None,
+                    fast_width=None, gstate=None, gmasks=None, gtrans=None,
+                    *, has_pack: bool):
+        B = lengths.shape[0]
+        grammar = gmasks is not None
+        if gstate is None:
+            gstate = jnp.zeros((B,), jnp.int32)
+        done = ~is_decode
+        n_out = jnp.zeros((B,), jnp.int32)
+        toks = jnp.zeros((max_steps, B), jnp.int32)
+        lps = jnp.zeros((max_steps, B), jnp.float32)
+
+        def stops(tokens, n_out, lengths, live):
+            is_eos = check_eos & jnp.any(
+                tokens[:, None] == eos_ids[None, :], axis=1)
+            return live & (is_eos | (n_out >= remaining)
+                           | (lengths >= limit))
+
+        i0 = jnp.int32(0)
+        if has_pack:
+            # iteration 0, unrolled: the exact single-step mixed ragged
+            # body. Every packed decode row samples and advances (the
+            # device cannot unpack a row), so the host only routes packs
+            # here when each decode entry has remaining budget >= 1.
+            mask0 = gmasks[gstate] if grammar else None
+            (tokens, lp, kc, vc, sampler, last_logits, lengths) = \
+                ragged_step(params, cos, sin, kc, vc, sampler, last_logits,
+                            lengths, pack["tokens"], pack["decode_slot"],
+                            is_decode, pack["set_len"], pack["logit_set"],
+                            pack["logit_rows"], pack["block_seq"],
+                            pack["qstart"], pack["qlen"], pack["kvlen"],
+                            table, kvt, mask0, pack.get("inject"))
+            toks = toks.at[0].set(tokens)
+            lps = lps.at[0].set(lp)
+            n_out = n_out + is_decode.astype(jnp.int32)
+            if grammar:
+                gstate = jnp.where(is_decode, gtrans[gstate, tokens], gstate)
+            done = done | stops(tokens, n_out, lengths, is_decode)
+            i0 = jnp.int32(1)
+
+        init = (i0, done, n_out, toks, lps, gstate, kc, vc, sampler,
+                last_logits, lengths)
+
+        def cond(carry):
+            i, done = carry[0], carry[1]
+            # first-finish exit: unlike build_decode_loop (which keeps
+            # looping until EVERY slot froze), one finished decode slot
+            # ends the dispatch — early-exit admission
+            return ((i < max_steps) & jnp.any(~done)
+                    & ~jnp.any(is_decode & done) & ~prefill_pending)
+
+        def body(carry):
+            (i, done, n_out, toks, lps, gstate, kc, vc, sampler,
+             last_logits, lengths) = carry
+            live = ~done
+            prev_key = sampler.key
+            mask = gmasks[gstate] if grammar else None
+            tokens, lp, kc, vc, sampler, logits, lengths = decode_step(
+                params, cos, sin, kc, vc, sampler, last_logits, lengths,
+                live, mask, fast_width, table, kvt)
+            sampler = dataclasses.replace(
+                sampler,
+                key=jnp.where(live[:, None], sampler.key, prev_key))
+            last_logits = jnp.where(live[:, None], logits, last_logits)
+            toks = toks.at[i].set(tokens)
+            lps = lps.at[i].set(lp)
+            n_out = n_out + live.astype(jnp.int32)
+            if grammar:
+                gstate = jnp.where(live, gtrans[gstate, tokens], gstate)
+            done = done | stops(tokens, n_out, lengths, live)
+            return (i + 1, done, n_out, toks, lps, gstate, kc, vc, sampler,
+                    last_logits, lengths)
+
+        (steps, done, n_out, toks, lps, _, kc, vc, sampler, last_logits,
+         lengths) = jax.lax.while_loop(cond, body, init)
+        exit_code = jnp.where(
+            jnp.any(is_decode & done), jnp.int32(RLOOP_EXIT_FINISH),
+            jnp.where(prefill_pending & jnp.any(~done),
+                      jnp.int32(RLOOP_EXIT_PREFILL),
+                      jnp.int32(RLOOP_EXIT_STEPS_CAP)))
+        return (toks, lps, n_out, steps, exit_code, kc, vc, sampler,
+                last_logits, lengths)
+
+    return ragged_loop
+
+
 def hidden_states(params, cfg: LlamaConfig, tokens, lengths=None):
     """Full-sequence causal forward → final-norm hidden states [B, S, H].
     `lengths` masks padded positions out of attention (defaults to full)."""

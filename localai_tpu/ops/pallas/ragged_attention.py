@@ -44,6 +44,17 @@ Tiers match the rest of ops/pallas:
   row-DMA kernel driven by host-precomputed (physical block, row) targets,
   one DMA per token, O(tokens) traffic.
 
+Loop-carried metadata (ISSUE 16): every metadata input — block_seq,
+qstart/qlen/kvlen, tables — is an ordinary traced array, never a static
+argument, so the fused multi-step ragged tick (models/llama.build_ragged_loop)
+can carry re-derived metadata through `lax.while_loop` iterations WITHOUT
+re-tracing this kernel: one trace serves iteration 0's mixed pack and every
+shape-identical dispatch after it. The only static inputs are the shapes
+themselves (T, pool dims, MAXB) and `sliding_window`; keep it that way —
+promoting any metadata value to Python int would re-specialize the program
+per tick and break the zero-recompile invariant the compile-count tripwire
+enforces.
+
 On CPU everything runs in interpreter mode (LOCALAI_FORCE_PALLAS=1 in
 tests); real-TPU lowering rides the same `pallas_works` probe gate.
 """
@@ -176,6 +187,10 @@ def ragged_paged_attention(q, k_pool, v_pool, block_seq, qstart, qlen,
     multiple of QBLK; pools [NB, KVH, BS, D]; metadata per the module
     docstring. Returns [T, H, D] in q.dtype (padding rows garbage)."""
     t, h, d = q.shape
+    if t % QBLK != 0:
+        raise ValueError(
+            f"ragged stream rows T={t} must be a multiple of QBLK={QBLK} "
+            "(the engine's token budget is QBLK-aligned by construction)")
     kvh = k_pool.shape[1]
     bs = k_pool.shape[2]
     group = h // kvh

@@ -10,12 +10,12 @@ serialized — profiling is a measurement mode, not a serving mode).
 Stage samples accumulate into log-spaced histograms so one snapshot answers
 "where do the milliseconds of a decode step go" (the Kernel Looping /
 PRESERVE-style per-stage attribution the 33 ms step needs): count, total,
-min/max, p50 (from the histogram), tokens/s, and two MFU numbers per stage:
-`mfu` — backed by XLA's per-program cost analysis when the engine has fed
-per-stage FLOP counts via set_costs() (ISSUE 13) — and
-`mfu_analytic_legacy`, the old 2·N·tokens decode-FLOP approximation (kept
-for scoreboard continuity; it overstates stages that don't run the full
-forward and knows nothing about bandwidth).
+min/max, p50 (from the histogram), tokens/s, and one MFU number per stage:
+`mfu`, backed by XLA's per-program cost analysis when the engine has fed
+per-stage FLOP counts via set_costs() (ISSUE 13); None until then. The old
+2·N·tokens analytic approximation (`mfu_analytic_legacy`) was kept one
+release for scoreboard continuity and removed in ISSUE 16 — it overstated
+stages that don't run the full forward and knew nothing about bandwidth.
 
 Everything here is jax-free until a fence is actually requested, so the
 module can load in processes that never touch the accelerator.
@@ -181,12 +181,6 @@ class StepProfiler:
             total = 0.0
             for name, st in self._stages.items():
                 total += st.total_s
-                legacy = None
-                if self.peak and self.n_params and st.total_s > 0 \
-                        and st.tokens:
-                    # global tokens over the WHOLE mesh's peak: per-chip MFU
-                    legacy = (2.0 * self.n_params * st.tokens
-                              / (st.total_s * self.peak * self.chips))
                 # cost-backed MFU (ISSUE 13): the stage's real compiled
                 # FLOPs per dispatch, over measured dispatch time and the
                 # mesh's peak — None until the engine feeds set_costs()
@@ -206,7 +200,6 @@ class StepProfiler:
                     "tok_s": (st.tokens / st.total_s
                               if st.total_s > 0 else 0.0),
                     "mfu": mfu,
-                    "mfu_analytic_legacy": legacy,
                     **({"cost_flops": cost["flops"],
                         "cost_bytes": cost["bytes"]} if cost else {}),
                     "hist_bucket_upper_ms": [
@@ -240,11 +233,6 @@ class StepProfiler:
                 out[f"{prefix}{name}_p50_ms"] = st.p50_s() * 1e3
                 if st.tokens and st.total_s > 0:
                     out[f"{prefix}{name}_tok_s"] = st.tokens / st.total_s
-                if self.peak and self.n_params and st.total_s > 0 \
-                        and st.tokens:
-                    out[f"{prefix}{name}_mfu_analytic_legacy"] = (
-                        2.0 * self.n_params * st.tokens
-                        / (st.total_s * self.peak * self.chips))
                 cost = self._costs.get(name)
                 if cost and cost["flops"] and self.peak and st.total_s > 0:
                     out[f"{prefix}{name}_mfu"] = (

@@ -95,6 +95,20 @@ REASON_CODES: dict[str, tuple[str, str]] = {
     "budget_cap": (
         "pack", "the ragged token budget filled; remaining decode rows or "
         "prefill chunks wait for the next tick"),
+    "loop_early_exit_finish": (
+        "pack", "a fused ragged loop exited because a decode slot finished "
+        "(EOS/max_tokens/context) — the host admits into the freed slot "
+        "immediately instead of waiting out the step cap"),
+    "loop_early_exit_prefill": (
+        "pack", "a fused ragged loop ran a single iteration because the "
+        "host flagged pending prefill/admission work at dispatch time"),
+    "loop_early_exit_host_arbitration": (
+        "pack", "a fused-capable ragged tick fell back to a single-step "
+        "dispatch: a live slot needs per-token host decisions (host-only "
+        "grammar masks or stop-string scans)"),
+    "loop_early_exit_steps_cap": (
+        "pack", "a fused ragged loop ran its full ragged_loop_steps budget "
+        "with no early-exit condition"),
 }
 
 DISPATCH_CODES: tuple[str, ...] = tuple(

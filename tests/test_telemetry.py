@@ -88,11 +88,10 @@ def test_profiler_histogram_and_flat():
     assert 0 < st["p50_ms"] <= 20
     assert sum(st["hist"]) == 10
     # mfu is COST-BACKED (ISSUE 13): None until set_costs supplies the
-    # compiled variant's FLOPs; the 2·N·tokens estimate keeps reporting as
-    # mfu_analytic_legacy
+    # compiled variant's FLOPs; the 2·N·tokens analytic estimate is gone
+    # (removed in ISSUE 16 after its one-release grace period)
     assert st["mfu"] is None
-    assert st["mfu_analytic_legacy"] is not None \
-        and st["mfu_analytic_legacy"] > 0
+    assert "mfu_analytic_legacy" not in st
     p.set_costs({"decode_block": {"flops": 2e6, "bytes": 1e6}})
     st = p.report()["stages"]["decode_block"]
     assert st["mfu"] is not None and st["mfu"] > 0
@@ -103,7 +102,7 @@ def test_profiler_histogram_and_flat():
     assert flat["prof_decode_block_count"] == 10.0
     assert flat["prof_admit_total_ms"] > 0
     assert flat["prof_decode_block_mfu"] > 0
-    assert flat["prof_decode_block_mfu_analytic_legacy"] > 0
+    assert not any(k.endswith("mfu_analytic_legacy") for k in flat)
 
 
 # ------------------------------------------------- engine instrumentation

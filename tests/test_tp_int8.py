@@ -508,14 +508,17 @@ def test_profiler_records_mesh_and_per_chip_mfu(mesh4):
     t0 = time.perf_counter() - 0.01
     prof.record("decode", t0, tokens=100)
     single.record("decode", t0, tokens=100)
+    # same compiled cost on both: the per-chip normalization lives entirely
+    # in the cost-backed MFU denominator (the analytic estimate is gone
+    # since ISSUE 16)
+    prof.set_costs({"decode": {"flops": 1e6, "bytes": 1e6}})
+    single.set_costs({"decode": {"flops": 1e6, "bytes": 1e6}})
     rep, srep = prof.report(), single.report()
     assert rep["mesh"] == {"data": 1, "model": 4} and rep["chips"] == 4
     assert srep["mesh"] is None and srep["chips"] == 1
     # same tokens, same wall time: per-chip-normalized MFU is 4x smaller
-    # (cost-backed mfu is None until set_costs — the analytic estimate
-    # carries the normalization contract)
-    ratio = (srep["stages"]["decode"]["mfu_analytic_legacy"]
-             / rep["stages"]["decode"]["mfu_analytic_legacy"])
+    ratio = (srep["stages"]["decode"]["mfu"]
+             / rep["stages"]["decode"]["mfu"])
     assert abs(ratio - 4.0) < 0.5
 
 

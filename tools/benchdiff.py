@@ -33,6 +33,7 @@ import sys
 RATIO_KEYS = (
     "ragged_over_dense", "mixed_over_equal", "constrained_over_plain",
     "paged_over_dense", "tp_over_single", "longctx_over_short",
+    "fused_over_ragged",
     "budget_utilization", "draft_acceptance", "mfu", "stage_coverage",
 )
 # lower is better; gate when NEW exceeds threshold-scaled OLD
