@@ -199,13 +199,14 @@ class LLMServicer(BackendServicer):
         cache_type = kv_kind
         # KV lifecycle tier rides the ModelOptions.options JSON blob (no
         # dedicated proto field — same lane as the hfapi endpoint override)
-        kv_policy, kv_cold_pages = "", 0
+        kv_policy, kv_cold_pages, kv_host_bytes = "", 0, 0
         if request.options:
             import json
 
             opts = json.loads(request.options)  # typos fail the load loudly
             kv_policy = str(opts.get("kv_policy", ""))
             kv_cold_pages = int(opts.get("kv_cold_pages", 0))
+            kv_host_bytes = int(opts.get("kv_host_bytes", 0))
         self.engine = Engine(cfg, params, tok, EngineConfig(
             max_slots=request.parallel or 4,
             max_context=context_size,
@@ -217,6 +218,7 @@ class LLMServicer(BackendServicer):
             kv_pages=request.kv_pages,
             kv_policy=kv_policy,
             kv_cold_pages=kv_cold_pages,
+            kv_host_bytes=kv_host_bytes,
         ), draft=draft)
         if request.embeddings:
             from localai_tpu.engine.embedder import CrossScorer
@@ -625,6 +627,10 @@ class LLMServicer(BackendServicer):
             # per-variant AOT cost-analysis compile, then it's cached)
             "sched": (self.engine.sched_snapshot()
                       if self.engine is not None else {}),
+            # host KV tier occupancy (ISSUE 17): /debug/slo's kv_host
+            # section; {} unless the engine runs with kv_host_bytes > 0
+            "kvhost": (self.engine.kvhost_snapshot()
+                       if self.engine is not None else {}),
             "flightrec": telemetry.flightrec().dump(),
             "pid": os.getpid(),
             "model": self.model_name,

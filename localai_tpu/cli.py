@@ -71,6 +71,13 @@ def _add_run(sub):
     p.add_argument("--kv-sinks", type=int, default=None,
                    help="keep the first N tokens (attention sinks) resident "
                         "alongside --kv-window")
+    p.add_argument("--kv-host-bytes", type=int, default=None,
+                   help="host-RAM KV spill tier budget in bytes (engine/"
+                        "kvhost.py): device blocks evicted by slot reclaim "
+                        "or the KV lifecycle tier are kept in host RAM "
+                        "(int8 sub-channel) and re-admitted on prefix-cache "
+                        "hits instead of re-prefilling; 0/unset disables. "
+                        "Per-model YAML kv_host_bytes wins")
     p.add_argument("--trace", action="store_true",
                    help="record request/engine spans (LOCALAI_TRACE=1); "
                         "export via /debug/trace or `util trace`")
@@ -419,6 +426,15 @@ def cli_util_sched(args) -> int:
                              f"{roof.get('bound', '?')}-bound  "
                              f"mfu≤{roof.get('mfu', 0):.1%}")
                 print(f"    {name:<{width}}  x{n:<8d}{extra}")
+        kvh = snap.get("kv_host") or {}
+        if kvh:
+            print(f"  kv host tier: {kvh.get('blocks', 0)} blocks "
+                  f"({kvh.get('bytes', 0) / 1e6:.1f} MB, peak "
+                  f"{kvh.get('peak_bytes', 0) / 1e6:.1f} MB of "
+                  f"{kvh.get('budget_bytes', 0) / 1e6:.1f} MB)  "
+                  f"hits {kvh.get('hits', 0)}  "
+                  f"spills {kvh.get('spills', 0)}  "
+                  f"evictions {kvh.get('evictions', 0)}")
         ticks = snap.get("recent_ticks") or []
         if ticks:
             print(f"  last tick: {_json.dumps(ticks[-1])}", file=_sys.stderr)

@@ -46,6 +46,11 @@ class AppConfig:
                                      # KV. A per-model YAML kv_policy wins.
     kv_sinks: int = 0                # attention-sink tokens kept alongside
                                      # the window (only with kv_window > 0)
+    kv_host_bytes: int = 0           # app-default host-RAM KV spill tier
+                                     # budget (engine/kvhost.py); evicted
+                                     # device blocks are kept in host RAM
+                                     # and re-admitted on prefix hits.
+                                     # 0 disables; per-model YAML wins.
     preload_models: list[str] = dataclasses.field(default_factory=list)
     log_level: str = "info"
     machine_tag: str = ""
@@ -66,7 +71,8 @@ class AppConfig:
                             ("breaker_cooldown", float),
                             ("queue_depth", int), ("drain_timeout", float),
                             ("spawn_retries", int), ("spawn_timeout", float),
-                            ("kv_window", int), ("kv_sinks", int)]:
+                            ("kv_window", int), ("kv_sinks", int),
+                            ("kv_host_bytes", int)]:
             v = env(field.upper(), cast)
             if v is not None:
                 setattr(cfg, field, v)

@@ -119,6 +119,9 @@ class ModelConfig:
                                      # window=W[, quantize_cold=true])"
     kv_cold_pages: int = 0           # int8 cold pool size in 128-token
                                      # blocks (quantize_cold policies)
+    kv_host_bytes: int = 0           # host-RAM KV spill tier budget in
+                                     # bytes (engine/kvhost.py); 0 = app
+                                     # default (--kv-host-bytes)
     mcp: dict = dataclasses.field(default_factory=dict)
                                      # MCP servers {servers: [...], stdio:
                                      # [...]} (reference config.MCP block)

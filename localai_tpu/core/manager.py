@@ -231,6 +231,9 @@ class ModelManager:
             opts["kv_policy"] = kv_policy
         if m.kv_cold_pages:
             opts["kv_cold_pages"] = m.kv_cold_pages
+        kv_host_bytes = m.kv_host_bytes or cfg.kv_host_bytes
+        if kv_host_bytes:
+            opts["kv_host_bytes"] = kv_host_bytes
         r = handle.client.load_model(
             options=json.dumps(opts) if opts else "",
             model=m.model_dir(cfg.models_path),

@@ -178,6 +178,15 @@ class EngineConfig:
                                   # with sub-channel per-token scales instead
                                   # of being dropped; a full cold pool falls
                                   # back to eviction (kv_evictions metric).
+    kv_host_bytes: int = 0        # host-RAM KV spill tier (engine/kvhost.py):
+                                  # byte budget for blocks the device pool
+                                  # evicts (slot reclaim, prefix-cache
+                                  # rewrite, kvtier eviction), held int8
+                                  # sub-channel and keyed by the prefix
+                                  # cache's chain hashes. Admission consults
+                                  # the tier after _match_prefix_blocks and
+                                  # re-admits hits H2D, overlapped with the
+                                  # uncovered suffix's prefill. 0 disables.
     max_restarts: int = 2         # fatal step() errors survived per engine
                                   # lifetime: in-flight streams fail, device
                                   # state is rebuilt, new requests serve
@@ -339,10 +348,16 @@ class Engine:
         tokenizer=None,
         econfig: EngineConfig | None = None,
         draft: tuple | None = None,
+        kvhost=None,
     ):
         """`draft=(draft_cfg, draft_params)` enables speculative decoding:
         the engine proposes ec.gamma tokens per step with the draft model and
-        verifies them in one target forward (engine/spec.py)."""
+        verifies them in one target forward (engine/spec.py).
+
+        `kvhost`: an existing engine/kvhost.HostKVPool to adopt instead of
+        building one from ec.kv_host_bytes — host RAM outlives device state,
+        so a restarted/rerouted worker re-admits the previous process's
+        spilled blocks (the bench --mode session restart leg)."""
         self.cfg = cfg
         self.params = params
         self.tok = tokenizer
@@ -465,6 +480,30 @@ class Engine:
             raise ValueError(
                 "kv_cold_pages needs kv_policy sink_window(..., "
                 "quantize_cold=true)")
+        # host-RAM KV spill tier (engine/kvhost.py, ISSUE 17): catches
+        # blocks the device pool evicts, keyed by the prefix cache's chain
+        # hashes. The pool may be injected (worker restart adopts the old
+        # process's host RAM); ec.kv_host_bytes=0 with no injected pool
+        # keeps self._kvhost None — every hook below is one branch.
+        self._kvhost = None
+        self._host_pending: list = []
+        self._spill_group: bytes | None = None
+        if kvhost is not None or self.ec.kv_host_bytes > 0:
+            if not self._paged:
+                raise ValueError(
+                    "kv_host_bytes requires paged KV (set kv_pages)")
+            if self._draft is not None:
+                raise ValueError(
+                    "kv_host_bytes is incompatible with a draft model "
+                    "(draft engines never consult the prefix cache)")
+            if self.ec.replicator is not None:
+                raise ValueError(
+                    "kv_host_bytes does not support multi-host replication "
+                    "(the spill/readmit transfers are host-rank state)")
+            from localai_tpu.engine.kvhost import HostKVPool
+
+            self._kvhost = (kvhost if kvhost is not None
+                            else HostKVPool(self.ec.kv_host_bytes))
         if self._draft is not None and self._draft[0].vocab_size != V:
             raise ValueError("draft vocab differs from target")
         self._kv_dtype = dtype
@@ -567,6 +606,14 @@ class Engine:
             self.metrics.update(
                 kv_cold_blocks=0, kv_evictions=0, kv_recomputes=0,
                 kv_policy_demotions=0, kv_blocks_in_use=0, kv_blocks_peak=0)
+        if self._kvhost is not None:
+            # host-tier telemetry (ISSUE 17): occupancy is refreshed from
+            # the pool at each _host_drain; hits/spills/evictions are the
+            # pool's cumulative counters (shared across engines adopting
+            # the same pool — restart legs keep their history)
+            self.metrics.update(
+                kv_host_blocks=0, kv_host_bytes=0, kv_host_bytes_peak=0,
+                kv_host_hits=0, kv_host_spills=0, kv_host_evictions=0)
 
         # telemetry (localai_tpu/telemetry): both gates resolve to None/False
         # here so the per-dispatch cost of a disabled build is one attribute
@@ -658,6 +705,9 @@ class Engine:
         self._deferred: tuple | None = None   # admission waiting on blocks
         self._admitting: tuple | None = None  # admission mid-device-call
         self._blocks_freed = False
+        # in-flight D2H spills (hash, group, _AsyncFetch) — dropped on a
+        # device-state rebuild: their source buffers died with the error
+        self._host_pending = []
         self._ragged_rr = 0   # ragged decode-row round-robin offset (fair
                               # rotation when the token budget can't hold
                               # every live slot in one tick)
@@ -1155,6 +1205,51 @@ class Engine:
                 return one(kc, ck), one(vc, cv)
 
             self._demote_fn = jax.jit(_demote, donate_argnums=(2, 3))
+
+        # host-RAM spill tier (ISSUE 17): slice ONE physical block out of
+        # the hot pool in int8 sub-channel form (spill), and write one host
+        # block back into fresh physical pages (readmit). pb is a traced
+        # scalar → one compiled program each however many blocks move (the
+        # compile-count tripwire pins decode_step; these are admission-side
+        # programs like _demote_fn). A quantized hot pool spills its q/s
+        # bytes verbatim — the round trip is byte-exact, which is what the
+        # --mode session greedy-parity gate measures; a dense pool pays the
+        # same quantize_tokens error the kvtier cold read path accepts.
+        self._spill_fn = None
+        self._readmit_fn = None
+        if self._kvhost is not None:
+            from localai_tpu.ops.kvcache import (
+                QuantKV, is_quant_kind, quantize_tokens,
+            )
+
+            if is_quant_kind(self.ec.cache_type):
+                def _spill(kc, vc, pb):
+                    return (kc.q[:, pb], kc.s[:, pb],
+                            vc.q[:, pb], vc.s[:, pb])
+
+                def _readmit(kc, vc, kq, ks, vq, vs, pb):
+                    return (QuantKV(kc.q.at[:, pb].set(kq),
+                                    kc.s.at[:, pb].set(ks)),
+                            QuantKV(vc.q.at[:, pb].set(vq),
+                                    vc.s.at[:, pb].set(vs)))
+            else:
+                def _spill(kc, vc, pb):
+                    def one(hot):
+                        q, scale = quantize_tokens(hot[:, pb])
+                        # scale [L,KVH,BS] → the stored [L,KVH,1,BS] tile
+                        return q, scale[:, :, None, :]
+                    (kq, ks), (vq, vs) = one(kc), one(vc)
+                    return kq, ks, vq, vs
+
+                def _readmit(kc, vc, kq, ks, vq, vs, pb):
+                    def one(hot, q, s):
+                        blk = (q.astype(jnp.float32)
+                               * s[:, :, 0, :, None]).astype(hot.dtype)
+                        return hot.at[:, pb].set(blk)
+                    return one(kc, kq, ks), one(vc, vq, vs)
+
+            self._spill_fn = jax.jit(_spill)
+            self._readmit_fn = jax.jit(_readmit, donate_argnums=(0, 1))
 
     # ------------------------------------------------------ device dispatch
     # Every device call goes through one of these. On a multi-host mesh the
@@ -1777,6 +1872,141 @@ class Engine:
                 jnp.int32(pb), jnp.int32(ci))
         self._obs("demote", t0, tokens=128, block=int(pb))
 
+    # ------------------------------------------------- host KV tier (ISSUE 17)
+
+    def _spill_block(self, pb: int, h: bytes | None = None,
+                     group: bytes | None = None):
+        """Spill physical block `pb` to the host tier before its content
+        dies (free, rewrite, or ring overwrite). The D2H copy starts NOW
+        (copy_to_host_async) and is enqueued on the device stream before
+        any later dispatch can rewrite the block, so finalizing it lazily
+        in _host_drain is race-free — the same ordering argument as
+        _dev_demote and the kvtier ring's slack blocks."""
+        if self._kvhost is None:
+            return
+        if h is None:
+            h = self._block_hash_of.get(pb)
+        if h is None or not self._kvhost.accepts(h):
+            return
+        t0 = time.perf_counter()
+        with activate_mesh(self.mesh):
+            arrs = self._spill_fn(self._kc, self._vc, jnp.int32(pb))
+        self._host_pending.append(
+            (h, group if group is not None else self._spill_group,
+             _AsyncFetch(arrs)))
+        self.metrics["kv_host_spills"] += 1
+        if self._sched is not None:
+            self._sched.reason("kv_host_spill", block=int(pb))
+        self._obs("host_spill", t0, tokens=128, block=int(pb))
+
+    def _host_drain(self):
+        """Land every in-flight spill in the HostKVPool. The copies were
+        started at spill time, so wait() here is normally a no-op fetch of
+        already-arrived host buffers — not a device stall."""
+        if not self._host_pending:
+            return
+        from localai_tpu.engine.kvhost import HostKVBlock
+
+        pending, self._host_pending = self._host_pending, []
+        evicted = 0
+        for h, group, fetch in pending:
+            kq, ks, vq, vs = fetch.wait()
+            evicted += self._kvhost.put(
+                h, HostKVBlock(kq=kq, ks=ks, vq=vq, vs=vs), group=group)
+        if evicted:
+            if self._sched is not None:
+                self._sched.reason("kv_host_evict_budget", blocks=evicted)
+            if self._flightrec is not None:
+                self._flightrec.record_event("kv_host_evict_budget",
+                                             blocks=evicted)
+        self._host_note()
+
+    def _host_note(self):
+        """Refresh the kv_host_* GetMetrics keys from the pool (the pool
+        may be shared across engines — restart legs keep its history)."""
+        st = self._kvhost.stats()
+        self.metrics["kv_host_blocks"] = st["blocks"]
+        self.metrics["kv_host_bytes"] = st["bytes"]
+        self.metrics["kv_host_bytes_peak"] = st["peak_bytes"]
+        self.metrics["kv_host_spills"] = st["spills"]
+        self.metrics["kv_host_hits"] = st["hits"]
+        self.metrics["kv_host_evictions"] = st["evictions"]
+
+    def _readmit_block(self, pb: int, blk):
+        """Write one host-tier block into physical page `pb` (H2D). The
+        jnp.asarray uploads are explicit sanctioned transfers on the
+        admission path — the decode transfer guard wraps decode dispatches
+        only, and the uploads overlap the uncovered suffix's prefill
+        chunks (they are enqueued first on the same stream)."""
+        t0 = time.perf_counter()
+        with activate_mesh(self.mesh):
+            self._kc, self._vc = self._readmit_fn(
+                self._kc, self._vc,
+                jnp.asarray(blk.kq), jnp.asarray(blk.ks),
+                jnp.asarray(blk.vq), jnp.asarray(blk.vs), jnp.int32(pb))
+        self._obs("host_readmit", t0, tokens=128, block=int(pb))
+
+    def _host_extend(self, slot: int, req: GenRequest, shared, shtok: int):
+        """Extend a device prefix-cache match with host-tier blocks.
+
+        Called from _admit_one right after _match_prefix_blocks: for each
+        chain hash past the device hit, a host hit re-admits into a fresh
+        physical page (registered in the hash index, so the NEXT tenant
+        finds it on device); the first miss on both tiers ends the run —
+        everything after it re-prefills. Returns the updated
+        (shared, shtok); readmitted blocks are ref'd like matched ones."""
+        if self._kvhost is None:
+            return shared, shtok
+        self._host_drain()   # a block spilled this tick is admissible now
+        from localai_tpu.ops.paged import BLOCK
+
+        limit = self.ec.max_context - 2 - self._ctx_reserve
+        nfull = min(len(req.prompt_ids) - 1, limit - 1) // BLOCK
+        base = len(shared) if shared is not None else 0
+        if nfull <= base:
+            return shared, shtok
+        chain = self._chain_hashes(req.prompt_ids[:nfull * BLOCK])
+        added: list[int] = []
+        for vb in range(base, nfull):
+            blk = self._kvhost.get(chain[vb])
+            if blk is None:
+                break
+            got = self._take_blocks(1, keep_slot=slot)
+            if got is None:
+                break
+            pb = got[0]
+            self._readmit_block(pb, blk)
+            # register: this page now holds the chain's content on device
+            self._drop_hash(pb)
+            self._hash_index[chain[vb]] = pb
+            self._block_hash_of[pb] = chain[vb]
+            added.append(pb)
+            if self._sched is not None:
+                self._sched.reason("kv_host_readmit", slot=int(slot),
+                                   block=int(pb))
+        if added:
+            shared = (list(shared) if shared is not None else []) + added
+            shtok = len(shared) * BLOCK
+            if self._flightrec is not None:
+                self._flightrec.record_event(
+                    "kv_host_readmit", slot=int(slot),
+                    blocks=len(added), covered_tokens=int(shtok))
+        elif nfull > base and self._sched is not None:
+            # both tiers missed at least one full prefix block: the
+            # uncovered prefix pays full re-prefill
+            self._sched.reason("kv_host_miss_reprefill",
+                               blocks=int(nfull - base))
+        self._host_note()
+        return shared, shtok
+
+    def kvhost_snapshot(self) -> dict:
+        """Host-tier stats for GetTrace/debug surfaces ({} when off)."""
+        if self._kvhost is None:
+            return {}
+        st = self._kvhost.stats()
+        st["pending"] = len(self._host_pending)
+        return st
+
     def _dev_install(self, idx, row, counts_row):
         """Sampler-row install for a ragged final prefill chunk (the dense
         path installs inside _extend_final; the ragged program defers it
@@ -2176,6 +2406,13 @@ class Engine:
                 # block-level prefix cache: another tenant's pages beat the
                 # slot-retained token match when they cover more prefix
                 shared, shtok = self._match_prefix_blocks(req.prompt_ids)
+                if self._kvhost is not None:
+                    # device miss → host tier: re-admit spilled blocks H2D
+                    # before falling back to re-prefill (ISSUE 17). The
+                    # uploads enqueue ahead of the suffix's prefill chunks,
+                    # so the DMA hides under prefill compute
+                    shared, shtok = self._host_extend(
+                        slot, req, shared, shtok)
                 if shtok > lcp:
                     lcp = shtok
                 else:
@@ -3321,6 +3558,30 @@ class Engine:
                     self.metrics["kv_evictions"] += 1
                     if self._sched is not None:
                         self._sched.reason("kv_eviction", slot=i, block=raw)
+                    if (self._kvhost is not None and s.shifted == 0
+                            and s.req.mm_embeds is None
+                            and (raw + 1) * BLOCK
+                            <= int(self._kv_window[i])):
+                        # the ring will overwrite this block — spill a copy
+                        # first. Ring content sits at TRUE positions (only
+                        # the column mapping rotates), and every token in a
+                        # block ending inside the first window span was
+                        # computed with its FULL history still attendable —
+                        # byte-equivalent to full-policy prefill, so it is
+                        # valid prefix-cache content for any future tenant.
+                        # Later blocks saw truncated attention and must not
+                        # be served cross-tenant. The ring's +2 slack
+                        # blocks order the async D2H before the wrap,
+                        # exactly as for _dev_demote
+                        ids = (list(s.req.prompt_ids) + s.gen_ids)
+                        if len(ids) >= (raw + 1) * BLOCK:
+                            chain = self._chain_hashes(
+                                ids[:(raw + 1) * BLOCK])
+                            col = sb + (raw - sb) % max(
+                                int(self._kv_rw[i]), 1)
+                            self._spill_block(
+                                int(self._table[i, col]), h=chain[raw],
+                                group=chain[0])
                     continue
                 ci = self._cold_free.pop()
                 col = sb + (raw - sb) % max(int(self._kv_rw[i]), 1)
@@ -3390,6 +3651,11 @@ class Engine:
                     else self._step_spec())
         if self._tiered:
             self._kv_tick()
+        if self._host_pending:
+            # land last tick's spills (their D2H copies have arrived by
+            # now) so the pool's occupancy metrics stay current even on
+            # admission-free ticks
+            self._host_drain()
         if self._ragged_now() and self._step_ragged():
             # mixed tick: decode + prefill ran as one ragged dispatch,
             # consumed synchronously (no pending survives a ragged tick)
@@ -3654,6 +3920,10 @@ class Engine:
             self._block_ref[pb] -= 1
             if self._block_ref[pb] <= 0:
                 self._block_ref[pb] = 0
+                if self._kvhost is not None:
+                    # last reference on registered content: catch it in the
+                    # host tier before the page returns to the free pool
+                    self._spill_block(pb)
                 self._drop_hash(pb)
                 self._kv_free.append(pb)
                 freed = True
@@ -3717,7 +3987,14 @@ class Engine:
             if victim is None:
                 return None
             self._released_lru.remove(victim)
+            if self._kvhost is not None and self._slot_blocks[victim]:
+                # the victim's retained chain dies as one session: group
+                # its spills under the chain-head hash so host-tier LRU
+                # evicts whole conversations, tail-first
+                self._spill_group = self._block_hash_of.get(
+                    self._slot_blocks[victim][0])
             self._unref_blocks(self._slot_blocks[victim])
+            self._spill_group = None
             self._slot_blocks[victim] = []
             self._slot_kv_tokens[victim] = []
             self._table[victim, :] = 0
@@ -3785,8 +4062,14 @@ class Engine:
                     lcp = j0 * BLOCK
         # the to-be-written blocks' old content is dead the moment the
         # first new row lands — their hash entries must go now, or the
-        # index would hand out pages mid-rewrite
+        # index would hand out pages mid-rewrite. The host tier catches
+        # each registered block on the way out (the spill's async D2H is
+        # enqueued before this request's first prefill dispatch can
+        # rewrite the page — same-stream ordering)
         for j in range(lcp // BLOCK, len(have)):
+            if self._kvhost is not None:
+                self._spill_block(
+                    have[j], group=self._block_hash_of.get(have[0]))
             self._drop_hash(have[j])
         self._table[slot, :] = 0
         self._table[slot, :len(have)] = have
@@ -4239,7 +4522,11 @@ class Engine:
                 self.rooflines()
             except Exception:
                 pass
-        return self._sched.snapshot(ticks)
+        snap = self._sched.snapshot(ticks)
+        kh = self.kvhost_snapshot()
+        if kh:
+            snap["kv_host"] = kh
+        return snap
 
     def start(self):
         """Run the engine loop in a background thread (serving mode)."""

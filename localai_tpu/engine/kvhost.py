@@ -1,0 +1,373 @@
+"""Host-RAM KV spill tier (ISSUE 17).
+
+The device block pool (ops/paged.py) is the only place KV lives today:
+when a retained slot is reclaimed, a prefix-cache block rewritten, or a
+windowed block evicted past the kvtier cold pool, the content is gone and
+the next turn of that conversation re-prefills from token zero.  This
+module adds the missing storage tier between the device pool and
+re-prefill:
+
+    device pool  --spill (async D2H, int8 sub-channel)-->  HostKVPool
+    HostKVPool   --re-admit (H2D, overlapped w/ prefill)-->  device pool
+
+Blocks are keyed by the same chained content hashes the prefix cache
+uses (engine._chain_hashes), so a host hit is exactly a prefix-cache hit
+that happens to live one tier further away.  Storage is int8 sub-channel
+(ops/kvcache.quantize_tokens layout): a spilled block from a quantized
+pool round-trips byte-exact (greedy parity 1.00); from a dense pool it
+pays the same quantization error the kvtier cold read path already
+accepts.
+
+The pool itself is pure host-side bookkeeping (numpy + dicts) so it can
+be unit-tested in milliseconds and handed to a fresh Engine to model a
+worker restart (``Engine(..., kvhost=survivor_pool)``).
+
+Also here: the federation-layer prefix digest.  The reverse proxy cannot
+tokenize, so cluster KV affinity is keyed on *text-chunk* chain hashes
+(``text_chain_ids``) computed identically by the proxy and every worker
+from the request body — a worker's digest covers a follow-up turn's hint
+iff it served the conversation's earlier turns.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+__all__ = [
+    "HostKVBlock", "HostKVPool", "PrefixDigest",
+    "text_chain_ids", "body_prompt_text",
+]
+
+
+# --------------------------------------------------------------------------
+# spilled block payload
+# --------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class HostKVBlock:
+    """One 128-token KV block in int8 sub-channel form.
+
+    kq/vq: int8  [L, KVH, BLOCK, D]
+    ks/vs: f32   [L, KVH, 1, BLOCK]   (quantize_tokens scale tile layout)
+    """
+
+    kq: np.ndarray
+    ks: np.ndarray
+    vq: np.ndarray
+    vs: np.ndarray
+
+    @property
+    def nbytes(self) -> int:
+        return (self.kq.nbytes + self.ks.nbytes
+                + self.vq.nbytes + self.vs.nbytes)
+
+
+@dataclass
+class _Entry:
+    block: HostKVBlock
+    group: bytes
+    pins: int = 0
+
+
+@dataclass
+class _Group:
+    # chain-ordered hashes; tail blocks are useless without their head, so
+    # budget eviction inside a group strips from the tail first
+    hashes: list = field(default_factory=list)
+
+
+# --------------------------------------------------------------------------
+# the pool
+# --------------------------------------------------------------------------
+
+class HostKVPool:
+    """Refcounted, byte-budgeted host store of spilled KV blocks.
+
+    Keys are the engine's chained content hashes (16-byte blake2b).
+    Blocks belong to a *group* (the chain-head hash of the session that
+    spilled them); eviction is LRU over groups — drop the
+    least-recently-touched session first, and within it tail blocks
+    before head blocks, since a chain is only usable as a leading run.
+
+    ``budget_bytes <= 0`` disables admission entirely (every ``put`` is
+    dropped), which lets callers keep one unconditional code path.
+
+    Thread-safe: the engine thread spills/readmits while the gRPC thread
+    reads ``stats()``/``digest()`` for metrics and health gossip.
+    """
+
+    def __init__(self, budget_bytes: int):
+        self.budget_bytes = int(budget_bytes)
+        self._lock = threading.Lock()
+        self._entries: dict[bytes, _Entry] = {}
+        # insertion/touch order == LRU order (oldest first)
+        self._groups: "OrderedDict[bytes, _Group]" = OrderedDict()
+        self.used_bytes = 0
+        # counters (cumulative; exported via engine.metrics kv_host_*)
+        self.spills = 0          # blocks admitted
+        self.hits = 0            # blocks re-admitted via get()
+        self.misses = 0          # probes that found nothing
+        self.evictions = 0       # blocks dropped to respect the budget
+        self.rejects = 0         # puts refused (dup / zero budget / pinned)
+        self.peak_bytes = 0
+
+    # -- admission ---------------------------------------------------------
+
+    def accepts(self, h: bytes) -> bool:
+        """Cheap pre-flight: would ``put`` store this hash?  Lets the
+        engine skip the device->host copy for dups and zero budgets."""
+        if self.budget_bytes <= 0:
+            return False
+        with self._lock:
+            return h not in self._entries
+
+    def put(self, h: bytes, block: HostKVBlock,
+            group: Optional[bytes] = None) -> int:
+        """Admit one block; returns number of blocks evicted for budget.
+
+        A duplicate hash is refused (first copy wins — content-addressed,
+        so the bytes are identical anyway).  A block larger than the
+        whole budget is refused rather than flushing the pool for it.
+        """
+        if self.budget_bytes <= 0 or block.nbytes > self.budget_bytes:
+            self.rejects += 1
+            return 0
+        gkey = group if group is not None else h
+        with self._lock:
+            if h in self._entries:
+                self.rejects += 1
+                return 0
+            self._entries[h] = _Entry(block=block, group=gkey)
+            g = self._groups.get(gkey)
+            if g is None:
+                g = self._groups[gkey] = _Group()
+            g.hashes.append(h)
+            self._groups.move_to_end(gkey)     # MRU
+            self.used_bytes += block.nbytes
+            self.spills += 1
+            self.peak_bytes = max(self.peak_bytes, self.used_bytes)
+            return self._evict_to_budget_locked()
+
+    def _evict_to_budget_locked(self) -> int:
+        evicted = 0
+        while self.used_bytes > self.budget_bytes:
+            victim = None
+            for gkey in self._groups:          # oldest group first
+                g = self._groups[gkey]
+                # tail-first inside the group; skip pinned blocks
+                for h in reversed(g.hashes):
+                    if self._entries[h].pins == 0:
+                        victim = (gkey, h)
+                        break
+                if victim:
+                    break
+            if victim is None:                 # everything pinned
+                break
+            gkey, h = victim
+            e = self._entries.pop(h)
+            self._groups[gkey].hashes.remove(h)
+            if not self._groups[gkey].hashes:
+                del self._groups[gkey]
+            self.used_bytes -= e.block.nbytes
+            self.evictions += 1
+            evicted += 1
+        return evicted
+
+    # -- lookup ------------------------------------------------------------
+
+    def get(self, h: bytes) -> Optional[HostKVBlock]:
+        """Non-destructive lookup; a hit touches the block's group (MRU)
+        so live sessions outlast idle ones."""
+        with self._lock:
+            e = self._entries.get(h)
+            if e is None:
+                self.misses += 1
+                return None
+            self.hits += 1
+            self._groups.move_to_end(e.group)
+            return e.block
+
+    def contains(self, h: bytes) -> bool:
+        with self._lock:
+            return h in self._entries
+
+    def pin(self, h: bytes) -> bool:
+        with self._lock:
+            e = self._entries.get(h)
+            if e is None:
+                return False
+            e.pins += 1
+            return True
+
+    def unpin(self, h: bytes) -> None:
+        with self._lock:
+            e = self._entries.get(h)
+            if e is not None and e.pins > 0:
+                e.pins -= 1
+
+    # -- introspection -----------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "blocks": len(self._entries),
+                "groups": len(self._groups),
+                "bytes": self.used_bytes,
+                "peak_bytes": self.peak_bytes,
+                "budget_bytes": self.budget_bytes,
+                "spills": self.spills,
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "rejects": self.rejects,
+            }
+
+    def digest(self, k: int = 128) -> list:
+        """Top-k most-recent block ids (hex) for health-poll gossip —
+        MRU groups first, chain order inside a group."""
+        out: list = []
+        with self._lock:
+            for gkey in reversed(self._groups):      # MRU first
+                for h in self._groups[gkey].hashes:
+                    out.append(h.hex())
+                    if len(out) >= k:
+                        return out
+        return out
+
+
+# --------------------------------------------------------------------------
+# federation prefix digest (text-chunk chain hashes)
+# --------------------------------------------------------------------------
+
+# one chunk ~= one prefill block's worth of text; the exact figure only
+# needs to be identical on the proxy and the workers, not token-accurate
+TEXT_CHUNK = 512
+
+
+def text_chain_ids(text: str, chunk: int = TEXT_CHUNK,
+                   limit: int = 64) -> list:
+    """Chained blake2b ids over fixed-size chunks of ``text``.
+
+    Chaining makes each id commit to the whole preceding conversation,
+    mirroring engine._chain_hashes over token blocks: a worker's digest
+    covers a follow-up turn's leading ids iff it served the same
+    conversation prefix.  Trailing partial chunks are dropped (they will
+    re-hash identically once the conversation grows past them).
+    """
+    data = text.encode("utf-8", errors="replace")
+    ids: list = []
+    prev = b""
+    for i in range(0, min(len(data) // chunk, limit)):
+        hh = hashlib.blake2b(digest_size=16)
+        hh.update(prev)
+        hh.update(data[i * chunk:(i + 1) * chunk])
+        prev = hh.digest()
+        ids.append(prev.hex())
+    return ids
+
+
+def body_prompt_text(body: dict) -> str:
+    """Canonical conversation text of an OpenAI-style request body.
+
+    Both the federation proxy and the workers run this over the same
+    JSON body, so their chain ids agree by construction.  Only fields
+    that are stable across turns of one conversation participate.
+    """
+    if not isinstance(body, dict):
+        return ""
+    msgs = body.get("messages")
+    if isinstance(msgs, list):
+        parts = []
+        for m in msgs:
+            if not isinstance(m, dict):
+                continue
+            content = m.get("content")
+            if isinstance(content, list):     # multimodal content parts
+                content = "".join(
+                    p.get("text", "") for p in content
+                    if isinstance(p, dict) and p.get("type") == "text")
+            if isinstance(content, str):
+                parts.append(f"{m.get('role', '')}\x1f{content}\x1e")
+        return "".join(parts)
+    prompt = body.get("prompt")
+    if isinstance(prompt, list):
+        prompt = "".join(p for p in prompt if isinstance(p, str))
+    return prompt if isinstance(prompt, str) else ""
+
+
+class PrefixDigest:
+    """Bounded MRU set of text-chain ids a worker has served.
+
+    Workers feed it from their chat/completions handlers; its ``to_list``
+    rides the /healthz response so the federation picker can score
+    KV affinity without an extra RPC.  Thread-safe (aiohttp handlers +
+    health responses share it).
+    """
+
+    def __init__(self, cap: int = 1024):
+        self.cap = int(cap)
+        self._lock = threading.Lock()
+        self._ids: "OrderedDict[str, None]" = OrderedDict()
+
+    def add(self, ids: list) -> None:
+        if not ids:
+            return
+        with self._lock:
+            for i in ids:
+                if i in self._ids:
+                    self._ids.move_to_end(i)
+                else:
+                    self._ids[i] = None
+            while len(self._ids) > self.cap:
+                self._ids.popitem(last=False)
+
+    def to_list(self, k: int = 128) -> list:
+        with self._lock:
+            # most recent last in OrderedDict; gossip MRU first
+            return list(reversed(self._ids))[:k]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ids)
+
+
+def coverage(digest, hint) -> int:
+    """Length of the leading run of ``hint`` ids present in ``digest``.
+
+    Chain ids commit to their whole prefix, so only a *leading* run is
+    re-usable KV — a mid-conversation match without its head is noise.
+    """
+    if not hint:
+        return 0
+    have = digest if isinstance(digest, (set, frozenset)) else set(digest)
+    n = 0
+    for i in hint:
+        if i not in have:
+            break
+        n += 1
+    return n
+
+
+def request_hint(raw_body: bytes, limit: int = 64) -> list:
+    """Best-effort text-chain hint from a raw (possibly non-JSON) proxy
+    request body.  Returns [] rather than raising — affinity is an
+    optimization, never a correctness gate."""
+    try:
+        body = json.loads(raw_body)
+    except Exception:
+        return []
+    text = body_prompt_text(body)
+    if not text:
+        return []
+    return text_chain_ids(text, limit=limit)

@@ -38,6 +38,12 @@ class Worker:
         self.total = 0
         self.healthy = True
         self.last_check = 0.0
+        # KV-affinity gossip (ISSUE 17): the worker's served-prefix digest
+        # — text-chunk chain ids (engine/kvhost.text_chain_ids) it reported
+        # on its last /healthz poll. pick(prompt_hint=) scores the leading
+        # run of a request's ids against this set so a conversation's
+        # follow-up turn lands where its KV (device or host tier) lives.
+        self.kv_digest: frozenset = frozenset()
         self.breaker = CircuitBreaker(threshold=breaker_threshold,
                                       cooldown=breaker_cooldown,
                                       name=f"worker:{self.url}")
@@ -66,15 +72,33 @@ class FederatedServer:
 
     # ------------------------------------------------------------ selection
 
-    def pick(self) -> Worker | None:
+    def pick(self, prompt_hint=None) -> Worker | None:
         live = [w for w in self.workers
                 if w.healthy and w.breaker.allow()]
         # all breakers open / all unhealthy: half-open probes re-admit
-        # workers after their cooldown; until then, any worker beats none
+        # workers after their cooldown; until then, any worker beats none.
+        # KV affinity never applies on this degraded path — a worker whose
+        # breaker is open doesn't get requests for holding the right KV
+        degraded = not live
         live = live or [w for w in self.workers if w.breaker.allow()] \
             or self.workers
         if not live:
             return None
+        if prompt_hint and not degraded:
+            # KV affinity (ISSUE 17): prefer the worker whose gossiped
+            # digest covers the longest leading run of the request's
+            # text-chain ids — turn 2 lands where turn 1's KV lives.
+            # Ties (including the no-coverage case) fall through to the
+            # configured strategy below over the tied workers.
+            from localai_tpu.engine.kvhost import coverage
+
+            scored = [(coverage(w.kv_digest, prompt_hint), w) for w in live]
+            best = max(c for c, _ in scored)
+            if best > 0:
+                tied = [w for c, w in scored if c == best]
+                if len(tied) == 1:
+                    return tied[0]
+                live = tied
         if self.strategy == "random":
             return random.choice(live)
         if self.strategy == "round_robin":
@@ -90,6 +114,16 @@ class FederatedServer:
             async with self._session.get(w.url + "/healthz",
                                          timeout=aiohttp.ClientTimeout(total=3)) as r:
                 w.healthy = r.status == 200
+                if w.healthy:
+                    # KV-affinity gossip rides the existing poll: workers
+                    # report their served-prefix digest in the healthz
+                    # body (server/http.py). Non-JSON bodies (older
+                    # workers) just leave the digest empty.
+                    try:
+                        info = await r.json()
+                        w.kv_digest = frozenset(info.get("kv_digest") or ())
+                    except Exception:
+                        pass
         except Exception:
             w.healthy = False
 
@@ -113,6 +147,7 @@ class FederatedServer:
         return web.json_response([{
             "url": w.url, "healthy": w.healthy, "in_flight": w.in_flight,
             "total": w.total, "breaker": w.breaker.state,
+            "kv_digest_size": len(w.kv_digest),
         } for w in self.workers])
 
     async def _proxy(self, request: web.Request):
@@ -122,11 +157,22 @@ class FederatedServer:
         if not self._authorized(request, body):
             raise web.HTTPUnauthorized(text="federation token required")
         last_error = None
+        # KV-affinity hint (ISSUE 17): text-chain ids of the request's
+        # conversation, computed from the SAME body bytes the worker will
+        # hash on its side — their digests agree by construction. Non-chat
+        # paths and unparseable bodies yield [] (plain load balancing).
+        hint: list = []
+        tail = request.match_info["tail"]
+        if request.method == "POST" and (
+                "chat/completions" in tail or "completions" in tail):
+            from localai_tpu.engine.kvhost import request_hint
+
+            hint = request_hint(body)
         # try up to len(workers) distinct workers (federated_server.go:66-99
         # skip-to-next-replica behavior)
         tried: set[str] = set()
         for _ in range(len(self.workers)):
-            w = self.pick()
+            w = self.pick(prompt_hint=hint)
             if w is None or w.url in tried:
                 break
             tried.add(w.url)

@@ -85,6 +85,17 @@ try:
     _SCHED_PAD = Gauge(
         "localai_sched_pad_rows_frac",
         "Fraction of allocated dispatch rows that were padding", ["model"])
+    # host-RAM KV tier (ISSUE 17): pool occupancy is a level (Gauge);
+    # spill/hit/eviction totals are cumulative (Counter via _counter_sync)
+    _KV_HOST = Gauge(
+        "localai_kv_host", "Host KV tier occupancy",
+        ["model", "stat"])
+    # NOTE: the counter family must not share the Gauge's base name —
+    # prometheus_client strips the _total suffix at registration, so
+    # "localai_kv_host_total" would collide with the Gauge above
+    _KV_HOST_EVENTS = Counter(
+        "localai_kv_host_events_total", "Host KV tier cumulative events",
+        ["model", "event"])
     # last cumulative value each counter child was synced to, keyed by the
     # label tuple — a backend restart resets its counters, which _counter_sync
     # treats as a fresh start (standard Prometheus counter-reset semantics)
@@ -211,6 +222,14 @@ class API:
         self.cfg = app_config
         self.configs = configs
         self.manager = manager
+        # KV-affinity gossip (ISSUE 17): text-chain ids of every chat/
+        # completion conversation this worker served, reported via /healthz
+        # so the federation picker routes follow-up turns here. Maintained
+        # unconditionally — it is a bounded dict of hex strings; the
+        # federation layer decides whether anyone listens.
+        from localai_tpu.engine.kvhost import PrefixDigest
+
+        self._kv_served = PrefixDigest(cap=2048)
         self.app = web.Application(middlewares=[self._middleware],
                                    client_max_size=app_config.max_request_bytes)
         r = self.app.router
@@ -636,7 +655,25 @@ class API:
     # ------------------------------------------------------------ endpoints
 
     async def _health(self, request):
-        return web.json_response({"status": "ok"})
+        # kv_digest: served-prefix gossip for the federation picker's KV
+        # affinity (ISSUE 17) — top-k most recent text-chain ids
+        return web.json_response({
+            "status": "ok",
+            "kv_digest": self._kv_served.to_list(k=256),
+        })
+
+    def _note_served(self, body: dict):
+        """Record a conversation's text-chain ids in the served-prefix
+        digest (same helpers the federation proxy hashes the raw body
+        with, so the ids agree by construction)."""
+        from localai_tpu.engine.kvhost import (
+            body_prompt_text, text_chain_ids,
+        )
+
+        try:
+            self._kv_served.add(text_chain_ids(body_prompt_text(body)))
+        except Exception:
+            pass   # gossip is advisory — never fail the request for it
 
     async def _metrics(self, request):
         if not _HAVE_PROM:
@@ -681,6 +718,17 @@ class API:
                     continue
                 if key == "sched_pad_rows_frac":
                     _SCHED_PAD.labels(name).set(v)
+                    continue
+                # host KV tier (ISSUE 17): occupancy levels vs cumulative
+                # event counts out of the same kv_host_* key family
+                if key in ("kv_host_blocks", "kv_host_bytes",
+                           "kv_host_bytes_peak"):
+                    _KV_HOST.labels(name, key[8:]).set(v)
+                    continue
+                if key in ("kv_host_hits", "kv_host_spills",
+                           "kv_host_evictions"):
+                    _counter_sync(_KV_HOST_EVENTS, (name, key[8:]),
+                                  float(v))
                     continue
                 if not key.startswith("prof_"):
                     continue
@@ -748,14 +796,20 @@ class API:
         decode path), straight from each backend engine's registry. Empty
         per-model blocks when LOCALAI_METRICS=0."""
         models = {}
+        kv_host = {}
         for payload in await self._backend_traces(
                 request.query.get("model", "")):
             models[payload["model"]] = payload.get("slo") or {}
+            if payload.get("kvhost"):
+                # host KV tier occupancy/hit stats (ISSUE 17) — present
+                # only for backends running with kv_host_bytes > 0
+                kv_host[payload["model"]] = payload["kvhost"]
         return web.json_response({
             "metrics_enabled": telemetry.metrics_enabled(),
             "bucket_edges_s": [b for b in telemetry.BUCKETS_S
                                if b != float("inf")],
             "models": models,
+            "kv_host": kv_host,
         })
 
     async def _debug_sched(self, request):
@@ -828,6 +882,7 @@ class API:
     async def _chat(self, request):
         body = await request.json()
         cfg = self._resolve(body)
+        self._note_served(body)
         messages = body.get("messages") or []
         if not messages:
             raise web.HTTPBadRequest(
@@ -990,6 +1045,7 @@ class API:
     async def _completions(self, request):
         body = await request.json()
         cfg = self._resolve(body)
+        self._note_served(body)
         prompt = body.get("prompt") or ""
         if isinstance(prompt, list):
             prompt = prompt[0] if prompt else ""
@@ -1780,6 +1836,7 @@ def run_server(args) -> int:
         drain_timeout=getattr(args, "drain_timeout", None),
         kv_window=getattr(args, "kv_window", None),
         kv_sinks=getattr(args, "kv_sinks", None),
+        kv_host_bytes=getattr(args, "kv_host_bytes", None),
     )
     for t in ("watchdog_idle_timeout", "watchdog_busy_timeout"):
         v = getattr(args, t, None)

@@ -92,6 +92,18 @@ REASON_CODES: dict[str, tuple[str, str]] = {
     "kv_cold_demotion": (
         "kv", "a window-exited block was quantized into the int8 cold "
         "pool"),
+    "kv_host_spill": (
+        "kv", "a dying device block (slot reclaim, prefix-cache rewrite, "
+        "or kvtier eviction) was spilled to the host-RAM KV tier"),
+    "kv_host_readmit": (
+        "kv", "a host-tier block was re-admitted H2D during admission, "
+        "extending the device prefix-cache hit"),
+    "kv_host_miss_reprefill": (
+        "kv", "device and host tiers both missed a full prefix block; the "
+        "uncovered prefix falls back to re-prefill"),
+    "kv_host_evict_budget": (
+        "kv", "host-tier blocks were dropped (LRU over sessions) to "
+        "respect the --kv-host-bytes budget"),
     "budget_cap": (
         "pack", "the ragged token budget filled; remaining decode rows or "
         "prefill chunks wait for the next tick"),

@@ -1,0 +1,360 @@
+"""Session KV hierarchy tests (engine/kvhost.py, ISSUE 17): the host-RAM
+spill tier (budget/LRU/pin/digest units), the federation text-chain prefix
+digest + KV-affinity picker, and the engine-driving re-admission flows
+(greedy parity through re-admitted int8 blocks, worker-restart adoption
+of a survivor pool).  Pool/digest/affinity units run in tier-1; the
+engine-driving streams are slow-marked and run standalone via -m session.
+"""
+import numpy as np
+import pytest
+
+from localai_tpu.engine.kvhost import (
+    HostKVBlock, HostKVPool, PrefixDigest, body_prompt_text, coverage,
+    request_hint, text_chain_ids,
+)
+
+
+def _blk(seed: int = 0) -> HostKVBlock:
+    """A tiny deterministic block: 8+16+8+16 = 48 bytes."""
+    r = np.random.default_rng(seed)
+    return HostKVBlock(
+        kq=r.integers(-128, 127, (1, 1, 4, 2)).astype(np.int8),
+        ks=r.random((1, 1, 1, 4)).astype(np.float32),
+        vq=r.integers(-128, 127, (1, 1, 4, 2)).astype(np.int8),
+        vs=r.random((1, 1, 1, 4)).astype(np.float32),
+    )
+
+
+BLK_BYTES = _blk().nbytes        # 48
+
+
+def _h(i: int) -> bytes:
+    return i.to_bytes(16, "big")
+
+
+# ------------------------------------------------------------ pool units
+
+
+def test_pool_put_get_roundtrip():
+    pool = HostKVPool(budget_bytes=1 << 20)
+    b = _blk(1)
+    assert pool.accepts(_h(1))
+    assert pool.put(_h(1), b) == 0
+    assert pool.contains(_h(1)) and len(pool) == 1
+    got = pool.get(_h(1))
+    np.testing.assert_array_equal(got.kq, b.kq)
+    np.testing.assert_array_equal(got.vs, b.vs)
+    # non-destructive: still resident, hit counted
+    assert pool.contains(_h(1))
+    st = pool.stats()
+    assert st["hits"] == 1 and st["spills"] == 1 and st["bytes"] == b.nbytes
+    assert pool.get(_h(2)) is None and pool.stats()["misses"] == 1
+
+
+def test_pool_refuses_dups_zero_budget_and_oversized():
+    pool = HostKVPool(budget_bytes=0)
+    assert not pool.accepts(_h(1))
+    assert pool.put(_h(1), _blk()) == 0 and len(pool) == 0
+    pool = HostKVPool(budget_bytes=1 << 20)
+    pool.put(_h(1), _blk())
+    assert not pool.accepts(_h(1))          # dup pre-flight
+    pool.put(_h(1), _blk())                 # dup put refused
+    assert len(pool) == 1 and pool.stats()["rejects"] == 1
+    tiny = HostKVPool(budget_bytes=BLK_BYTES - 1)   # block > whole budget
+    assert tiny.put(_h(1), _blk()) == 0
+    assert len(tiny) == 0 and tiny.stats()["rejects"] == 1
+
+
+def test_pool_budget_evicts_lru_group_tail_first():
+    # room for exactly 3 blocks; two groups of 2 would overflow by 1
+    pool = HostKVPool(budget_bytes=3 * BLK_BYTES)
+    g1, g2 = _h(100), _h(200)
+    pool.put(_h(1), _blk(1), group=g1)
+    pool.put(_h(2), _blk(2), group=g1)
+    pool.put(_h(3), _blk(3), group=g2)
+    assert pool.put(_h(4), _blk(4), group=g2) == 1
+    # oldest group (g1) loses its TAIL block (_h(2)); its head survives
+    assert pool.contains(_h(1)) and not pool.contains(_h(2))
+    assert pool.contains(_h(3)) and pool.contains(_h(4))
+    st = pool.stats()
+    assert st["evictions"] == 1 and st["bytes"] == 3 * BLK_BYTES
+    assert st["peak_bytes"] == 4 * BLK_BYTES
+    # a get() touches g1 to MRU: the next overflow victimizes g2 instead
+    pool.get(_h(1))
+    pool.put(_h(5), _blk(5), group=g1)
+    assert not pool.contains(_h(4)) and pool.contains(_h(1))
+
+
+def test_pool_pin_blocks_eviction():
+    pool = HostKVPool(budget_bytes=2 * BLK_BYTES)
+    pool.put(_h(1), _blk(1), group=_h(100))
+    pool.put(_h(2), _blk(2), group=_h(100))
+    assert pool.pin(_h(1)) and pool.pin(_h(2))
+    # everything resident is pinned: the only evictable block is the
+    # newcomer itself, so the budget holds and the pinned pair survives
+    pool.put(_h(3), _blk(3), group=_h(200))
+    assert pool.contains(_h(1)) and pool.contains(_h(2))
+    assert not pool.contains(_h(3))
+    assert pool.stats()["bytes"] == 2 * BLK_BYTES
+    pool.unpin(_h(2))
+    pool.put(_h(4), _blk(4), group=_h(200))
+    assert not pool.contains(_h(2))         # unpinned tail goes first
+    assert pool.contains(_h(4))
+    assert not pool.pin(_h(99))             # absent hash
+
+
+def test_pool_digest_mru_groups_chain_order():
+    pool = HostKVPool(budget_bytes=1 << 20)
+    pool.put(_h(1), _blk(1), group=_h(100))
+    pool.put(_h(2), _blk(2), group=_h(100))
+    pool.put(_h(3), _blk(3), group=_h(200))
+    # g200 is MRU: digest leads with it, then g100 in CHAIN order
+    assert pool.digest() == [_h(3).hex(), _h(1).hex(), _h(2).hex()]
+    pool.get(_h(1))                          # touch g100
+    assert pool.digest(k=2) == [_h(1).hex(), _h(2).hex()]
+
+
+# ------------------------------------------- text-chain ids / coverage
+
+
+def test_text_chain_ids_chained_prefix_stability():
+    a = "x" * 1024 + "y" * 512
+    ids_a = text_chain_ids(a)
+    assert len(ids_a) == 3
+    # growing the conversation keeps the leading ids identical
+    assert text_chain_ids(a + "z" * 600)[:3] == ids_a
+    # trailing partial chunk never hashes
+    assert text_chain_ids(a + "z" * 100) == ids_a
+    # chaining: same chunk content, different prefix -> different id
+    b = "w" * 512 + a[512:]
+    assert text_chain_ids(b)[1] != ids_a[1]
+    assert text_chain_ids("short") == []
+    assert len(text_chain_ids("q" * 10240, limit=4)) == 4
+
+
+def test_body_prompt_text_shapes():
+    msgs = {"messages": [
+        {"role": "system", "content": "be brief"},
+        {"role": "user", "content": [
+            {"type": "text", "text": "what is"},
+            {"type": "image_url", "image_url": {"url": "ignored"}},
+            {"type": "text", "text": " this?"},
+        ]},
+    ]}
+    t = body_prompt_text(msgs)
+    assert "be brief" in t and "what is this?" in t and "ignored" not in t
+    # role participates (same content under another role must differ)
+    other = {"messages": [{"role": "user", "content": "be brief"}]}
+    assert body_prompt_text(other) != body_prompt_text(
+        {"messages": [{"role": "system", "content": "be brief"}]})
+    assert body_prompt_text({"prompt": "plain"}) == "plain"
+    assert body_prompt_text({"prompt": ["a", "b"]}) == "ab"
+    assert body_prompt_text({"prompt": 7}) == ""
+    assert body_prompt_text("nope") == ""
+
+
+def test_prefix_digest_mru_and_cap():
+    d = PrefixDigest(cap=3)
+    d.add(["a", "b"])
+    d.add(["c", "d"])                        # 'a' falls off the cap
+    assert len(d) == 3
+    assert d.to_list() == ["d", "c", "b"]    # MRU first
+    d.add(["b"])                             # touch to MRU
+    assert d.to_list(k=2) == ["b", "d"]
+    d.add([])                                # no-op
+
+
+def test_coverage_leading_run_only():
+    digest = frozenset(["a", "b", "d"])
+    assert coverage(digest, ["a", "b", "c", "d"]) == 2
+    assert coverage(digest, ["c", "a"]) == 0  # mid-match without head: 0
+    assert coverage(digest, []) == 0
+    assert coverage(frozenset(), ["a"]) == 0
+    assert coverage(["a", "b"], ["a", "b"]) == 2   # list digest works too
+
+
+def test_request_hint_best_effort():
+    import json
+
+    body = {"messages": [{"role": "user", "content": "m" * 2048}]}
+    hint = request_hint(json.dumps(body).encode())
+    assert hint == text_chain_ids(body_prompt_text(body))
+    assert len(hint) >= 2
+    assert request_hint(b"not json{") == []
+    assert request_hint(json.dumps({"prompt": ""}).encode()) == []
+
+
+# ------------------------------------------------------------ federation
+
+
+def test_pick_prefers_kv_coverage():
+    from localai_tpu.federation import FederatedServer
+
+    fed = FederatedServer(["http://a", "http://b", "http://c"])
+    wa, wb, wc = fed.workers
+    hint = text_chain_ids("h" * 2048)        # 4 ids
+    wb.kv_digest = frozenset(hint[:3])
+    wc.kv_digest = frozenset(hint[:1])
+    assert fed.pick(prompt_hint=hint) is wb
+    # no hint: falls back to least_used
+    wa.in_flight, wb.in_flight, wc.in_flight = 0, 5, 5
+    assert fed.pick() is wa
+    # zero coverage everywhere: strategy decides, not affinity
+    assert fed.pick(prompt_hint=["zzz"]) is wa
+
+
+def test_pick_affinity_skips_dead_and_degraded():
+    from localai_tpu.federation import FederatedServer
+
+    fed = FederatedServer(["http://a", "http://b"])
+    wa, wb = fed.workers
+    hint = text_chain_ids("h" * 2048)
+    wa.kv_digest = frozenset(hint)
+    wa.healthy = False                       # KV lives on a dead worker
+    assert fed.pick(prompt_hint=hint) is wb  # affinity never picks dead
+    wb.healthy = False                       # fully degraded cluster
+    got = fed.pick(prompt_hint=hint)
+    assert got is not None                   # any worker beats none
+    # coverage ties break by strategy (least_used)
+    wa.healthy = wb.healthy = True
+    wb.kv_digest = frozenset(hint)
+    wa.in_flight, wb.in_flight = 9, 1
+    assert fed.pick(prompt_hint=hint) is wb
+
+
+def test_sched_reason_codes_registered():
+    from localai_tpu.telemetry.sched import REASON_CODES, reason_category
+
+    for code in ("kv_host_spill", "kv_host_readmit",
+                 "kv_host_miss_reprefill", "kv_host_evict_budget"):
+        assert code in REASON_CODES
+        assert reason_category(code) == "kv"
+
+
+# ------------------------------------------------------ engine-driving
+
+TINY = dict(vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+            max_position=512, dtype="float32")
+
+
+@pytest.fixture(scope="module")
+def tiny_parts():
+    import jax
+
+    from localai_tpu.models.llama import LlamaConfig, init_params
+
+    cfg = LlamaConfig(**TINY)
+    return cfg, init_params(cfg, jax.random.PRNGKey(0))
+
+
+def _engine(tiny_parts, **kw):
+    from localai_tpu.engine.engine import Engine, EngineConfig
+
+    cfg, params = tiny_parts
+    kvhost = kw.pop("kvhost", None)
+    # kv_pages is TIGHT on purpose: 5 usable blocks barely fit one
+    # conversation, so the churn tenants must reclaim the released turn-1
+    # chain — the host tier is then its only home
+    base = dict(max_slots=2, max_context=512, prefill_buckets=(64,),
+                prefill_chunk=64, kv_pages=6, prompt_cache=True,
+                cache_type="int8")
+    base.update(kw)
+    return Engine(cfg, params, None, EngineConfig(**base), kvhost=kvhost)
+
+
+def _run(eng, ids, n=8):
+    from localai_tpu.engine.engine import GenRequest
+    from localai_tpu.ops.sampling import SamplingParams
+
+    rid, out = eng.submit(GenRequest(
+        prompt_ids=list(ids), max_tokens=n,
+        params=SamplingParams(temperature=0.0), ignore_eos=True))
+    toks = []
+    while True:
+        eng.step()
+        while not out.empty():
+            so = out.get()
+            if so.token_id >= 0:
+                toks.append(so.token_id)
+            if so.finished:
+                while eng.step():
+                    pass
+                return toks
+
+
+def _churn(eng, n_tenants=3, length=256):
+    for s in range(41, 41 + n_tenants):
+        r = np.random.default_rng(s)
+        _run(eng, r.integers(1, 127, length).tolist(), n=4)
+
+
+def test_kv_host_requires_paged_pool(tiny_parts):
+    with pytest.raises(ValueError, match="paged"):
+        _engine(tiny_parts, kv_pages=0, kv_host_bytes=1 << 20)
+
+
+@pytest.mark.slow
+@pytest.mark.session
+def test_readmission_parity_and_budget(tiny_parts):
+    """Turn 2 after device-pool churn re-admits spilled int8 blocks from
+    the host tier and reproduces the warm device-hit greedy stream bit for
+    bit; metrics move and the byte budget holds."""
+    r = np.random.default_rng(7)
+    t1 = r.integers(1, 127, 256).tolist()
+
+    warm = _engine(tiny_parts)               # no host tier: device hit ref
+    g1 = _run(warm, t1)
+    conv = t1 + g1 + r.integers(1, 127, 64).tolist()
+    ref = _run(warm, conv)                   # retained-on-device resume
+
+    eng = _engine(tiny_parts, kv_host_bytes=1 << 26)
+    assert _run(eng, t1) == g1
+    _churn(eng)                              # reclaim turn-1's chain
+    eng._host_drain()
+    st = eng.kvhost_snapshot()
+    assert st["blocks"] > 0 and st["spills"] > 0
+    assert eng._kvhost.digest()              # gossip sees the spills
+    hits0 = eng.metrics["kv_host_hits"]
+    got = _run(eng, conv)
+    eng._host_drain()
+    assert eng.metrics["kv_host_hits"] > hits0      # host tier actually hit
+    assert got == ref                                # greedy parity 1.00
+    st = eng.kvhost_snapshot()
+    assert st["peak_bytes"] <= st["budget_bytes"]
+    assert eng.metrics["kv_host_bytes_peak"] == st["peak_bytes"]
+    assert "pending" in st
+    # reason codes reached the sched ledger
+    codes = (eng.sched_snapshot().get("reason_counters") or {})
+    assert codes.get("kv_host_spill", 0) > 0
+    assert codes.get("kv_host_readmit", 0) > 0
+
+
+@pytest.mark.slow
+@pytest.mark.session
+def test_worker_restart_adopts_survivor_pool(tiny_parts):
+    """A FRESH engine handed the survivor HostKVPool re-admits the old
+    worker's spilled blocks: turn 2 after a restart matches the warm
+    stream without re-prefilling the covered prefix."""
+    r = np.random.default_rng(9)
+    t1 = r.integers(1, 127, 256).tolist()
+
+    warm = _engine(tiny_parts)
+    g1 = _run(warm, t1)
+    conv = t1 + g1 + r.integers(1, 127, 64).tolist()
+    ref = _run(warm, conv)
+
+    old = _engine(tiny_parts, kv_host_bytes=1 << 26)
+    assert _run(old, t1) == g1
+    _churn(old)
+    old._host_drain()
+    survivor = old._kvhost
+    assert len(survivor) > 0
+
+    fresh = _engine(tiny_parts, kvhost=survivor)     # the restarted worker
+    hits0 = survivor.stats()["hits"]
+    got = _run(fresh, conv)
+    assert survivor.stats()["hits"] > hits0
+    assert got == ref
+    reused = int(fresh.metrics.get("prompt_tokens_reused", 0))
+    assert reused >= 128                     # at least one re-admitted block

@@ -34,10 +34,19 @@ RATIO_KEYS = (
     "ragged_over_dense", "mixed_over_equal", "constrained_over_plain",
     "paged_over_dense", "tp_over_single", "longctx_over_short",
     "fused_over_ragged",
+    # --mode session (ISSUE 17): turn-2 re-prefill TTFT over host-tier
+    # re-admission TTFT — the self-relative speedup the host KV tier buys;
+    # a re-admission regression shrinks it
+    "readmit_speedup",
     "budget_utilization", "draft_acceptance", "mfu", "stage_coverage",
 )
-# lower is better; gate when NEW exceeds threshold-scaled OLD
-INVERSE_KEYS = ("pad_rows_frac", "host_sync_wait_ms_per_token")
+# lower is better; gate when NEW exceeds threshold-scaled OLD.
+# turn2_over_turn1_ttft is the session-mode re-admission gate (ISSUE 17):
+# turn-2 TTFT through the host tier over turn-1 full-prefill TTFT — it
+# GROWS when re-admission regresses, so it belongs on the inverse side
+# (its RATIO_KEYS twin is readmit_speedup above)
+INVERSE_KEYS = ("pad_rows_frac", "host_sync_wait_ms_per_token",
+                "turn2_over_turn1_ttft")
 # integer invariants: any growth is a regression (new compiles mid-stream,
 # new dense fallbacks) — these are exact, not noisy
 GROWTH_KEYS = ("compile_count_delta",)
