@@ -356,7 +356,25 @@ class LLMServicer(BackendServicer):
                 trace_parent: int = 0):
         from localai_tpu.engine import GenRequest
 
-        ids = self._prompt_ids(request, context)
+        resume = None
+        max_tokens = request.tokens or 128
+        if request.resume_json:
+            # preemption resume (ISSUE 19): the request carries a ResumeToken
+            # — prompt becomes original+emitted, the payload drives the
+            # engine's RNG/grammar/detok fixups, and the token budget shrinks
+            # by what the preempted stream already produced
+            from localai_tpu.engine.resume import ResumeToken
+
+            try:
+                tok = ResumeToken.from_json(request.resume_json)
+            except (ValueError, KeyError, TypeError) as e:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                              f"bad resume_json: {e}")
+            ids = tok.resume_prompt
+            resume = tok.payload()
+            max_tokens = max(1, max_tokens - tok.generated)
+        else:
+            ids = self._prompt_ids(request, context)
         mm_embeds = mm_positions = None
         if request.images:
             if self.vision is None:
@@ -374,7 +392,8 @@ class LLMServicer(BackendServicer):
         req = GenRequest(
             prompt_ids=ids,
             params=self._sampling(request),
-            max_tokens=request.tokens or 128,
+            max_tokens=max_tokens,
+            resume=resume,
             stop=tuple(request.stop_prompts),
             ignore_eos=request.ignore_eos,
             logprobs=request.logprobs,
@@ -402,7 +421,7 @@ class LLMServicer(BackendServicer):
         # servicer tests pass context=None.)
         if context is not None:
             context.add_callback(lambda: self.engine.cancel(rid))
-        return rid, out
+        return rid, out, ids
 
     def _encode_images(self, ids, images):
         """b64 images + prompt ids with <image> placeholders → (expanded ids,
@@ -443,8 +462,8 @@ class LLMServicer(BackendServicer):
         text, ids, logprobs, ttft = [], [], [], 0.0
         o = None
         try:
-            rid, out = self._submit(request, context, trace_id=trace_id,
-                                    trace_parent=gspan.sid if gspan else 0)
+            rid, out, _ = self._submit(request, context, trace_id=trace_id,
+                                       trace_parent=gspan.sid if gspan else 0)
             while True:
                 o = out.get()
                 if o.token_id >= 0 and not ttft:
@@ -478,6 +497,14 @@ class LLMServicer(BackendServicer):
         self._require_engine(context)
         _inject_faults(context)
         stall = faults.fire("stall_stream")
+        # preemption chaos kinds (ISSUE 19): `preempt:grace` raises SIGTERM
+        # once the first token is out (the spill-drain path — server.py's
+        # handler runs servicer.preempt and the terminal "preempted" reply
+        # flushes through this still-open stream); `kill9_middecode:N` SIGKILLs
+        # the process at the N-th emitted token — no drain, no checkpoint,
+        # the HTTP bridge must resume from its own accumulated state
+        pre_grace = faults.fire("preempt")
+        kill_at = faults.fire("kill9_middecode")
         t0 = time.monotonic()
         trace_id = _request_id(context)
         tr = telemetry.maybe_tracer()
@@ -485,10 +512,13 @@ class LLMServicer(BackendServicer):
                          args={"request_id": trace_id}) if tr else None
         ttft = 0.0
         sent_text = False
+        emitted = 0
+        first = True
         o = None
         try:
-            rid, out = self._submit(request, context, trace_id=trace_id,
-                                    trace_parent=gspan.sid if gspan else 0)
+            rid, out, ids = self._submit(request, context, trace_id=trace_id,
+                                         trace_parent=gspan.sid if gspan
+                                         else 0)
             while True:
                 o = out.get()
                 if sent_text and stall:
@@ -499,8 +529,22 @@ class LLMServicer(BackendServicer):
                     stall = None
                 if o.text:
                     sent_text = True
-                if o.token_id >= 0 and not ttft:
-                    ttft = time.monotonic() - t0
+                if o.token_id >= 0:
+                    emitted += 1
+                    if not ttft:
+                        ttft = time.monotonic() - t0
+                resume_json = ""
+                if first and not o.finished:
+                    # minimal checkpoint on the FIRST chunk: the tokenized
+                    # prompt, so the HTTP bridge can rebuild prompt+emitted
+                    # for resume/deterministic-replay after an ungraceful
+                    # death (no spill-drain ran, no full token exists)
+                    resume_json = json.dumps({"v": 1, "prompt_ids": ids})
+                elif o.finish_reason == "preempted" and o.resume is not None:
+                    # spill-drain checkpoint: the full ResumeToken rides the
+                    # terminal reply out before the process exits
+                    resume_json = json.dumps(o.resume)
+                first = False
                 yield pb.Reply(
                     message=o.text.encode(),
                     tokens=o.generated_tokens,
@@ -514,9 +558,21 @@ class LLMServicer(BackendServicer):
                     finish_reason=o.finish_reason or "",
                     timings_json=(json.dumps(o.timings)
                                   if o.finished and o.timings else ""),
+                    resume_json=resume_json,
                 )
                 if o.finished:
                     return
+                if emitted and pre_grace is not None:
+                    import signal
+
+                    os.environ["LOCALAI_PREEMPT_GRACE"] = str(pre_grace)
+                    pre_grace = None
+                    os.kill(os.getpid(), signal.SIGTERM)
+                if (kill_at is not None
+                        and emitted >= max(1, int(kill_at))):
+                    import signal
+
+                    os.kill(os.getpid(), signal.SIGKILL)
         finally:
             # client disconnects mid-stream (GeneratorExit) and _submit
             # aborts land here too — the span must always close
@@ -636,6 +692,15 @@ class LLMServicer(BackendServicer):
             "model": self.model_name,
         }
         return pb.Reply(message=json.dumps(payload).encode())
+
+    def preempt(self, grace: float = 0.0) -> list[dict]:
+        """Spill-drain the engine (ISSUE 19): freeze live slots, spill their
+        KV into the host pool, and emit terminal "preempted" replies carrying
+        ResumeTokens through the open streams. Returns the resume manifest
+        (server.py's SIGTERM fast-path calls this before stopping)."""
+        if self.engine is None:
+            return []
+        return self.engine.preempt(grace)
 
     def shutdown(self):
         if self.engine is not None:

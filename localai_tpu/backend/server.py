@@ -111,8 +111,28 @@ def serve_blocking(addr: str = "127.0.0.1:50051", backend: str = "llm",
     print(f"backend[{backend}] serving on port {port}", flush=True)
     stop = threading.Event()
 
+    def _preempt_then_stop():
+        # preemption fast-path (ISSUE 19): spill-drain live slots so their
+        # terminal "preempted" replies (carrying ResumeTokens) flush through
+        # the still-open streams, THEN stop. The drain runs off the signal
+        # handler thread — engine.preempt blocks until the freeze completes.
+        import os
+
+        try:
+            grace = float(os.environ.get("LOCALAI_PREEMPT_GRACE", "0") or 0)
+            servicer.preempt(grace)
+        except Exception:
+            import traceback
+
+            traceback.print_exc()
+        finally:
+            stop.set()
+
     def _sig(signum, frame):
-        stop.set()
+        if signum == signal.SIGTERM and hasattr(servicer, "preempt"):
+            threading.Thread(target=_preempt_then_stop, daemon=True).start()
+        else:
+            stop.set()
 
     signal.signal(signal.SIGTERM, _sig)
     signal.signal(signal.SIGINT, _sig)

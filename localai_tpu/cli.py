@@ -62,6 +62,11 @@ def _add_run(sub):
                    help="graceful-shutdown hard deadline: SIGTERM and "
                         "/backend/shutdown let in-flight requests finish "
                         "this long while new work gets 503 (default 30)")
+    p.add_argument("--preempt-grace", type=float, default=None,
+                   help="preemption spill-drain grace in seconds: on a "
+                        "preemption notice (backend SIGTERM or "
+                        "/backend/preempt) live slots run this long before "
+                        "being frozen into resume checkpoints (default 0)")
     # KV lifecycle tier (engine/kvtier.py) — app-wide default; a per-model
     # YAML kv_policy wins
     p.add_argument("--kv-window", type=int, default=None,

@@ -38,6 +38,10 @@ class AppConfig:
     queue_depth: int = 8             # per-model bounded wait queue; beyond
                                      # in-flight+queue → 429 + Retry-After
     drain_timeout: float = 30.0      # graceful-shutdown hard deadline (s)
+    preempt_grace: float = 0.0       # spill-drain grace (s): how long a
+                                     # preempted backend lets live slots run
+                                     # before force-freezing them into
+                                     # ResumeTokens (ISSUE 19)
     spawn_retries: int = 2           # fresh-port respawns when the child
                                      # dies before health (port TOCTOU)
     spawn_timeout: float = 120.0     # health budget per spawn attempt (s)
@@ -70,6 +74,7 @@ class AppConfig:
                             ("breaker_threshold", int),
                             ("breaker_cooldown", float),
                             ("queue_depth", int), ("drain_timeout", float),
+                            ("preempt_grace", float),
                             ("spawn_retries", int), ("spawn_timeout", float),
                             ("kv_window", int), ("kv_sinks", int),
                             ("kv_host_bytes", int)]:

@@ -137,6 +137,11 @@ class ModelManager:
         # chaos-harness targeting: fault specs may scope to one model name
         # (localai_tpu/testing/faults.py) — stamp the child so they can
         env["LOCALAI_FAULT_MODEL"] = cfg.name
+        # preemption grace (ISSUE 19): how long the backend's SIGTERM
+        # fast-path lets live slots run before force-freezing them
+        grace = getattr(self.app, "preempt_grace", 0.0) or 0.0
+        if grace:
+            env["LOCALAI_PREEMPT_GRACE"] = str(grace)
         # gallery-installed external backend? its run.sh owns the process
         # (reference initializers.go:50-99 — external backends launch from
         # the backends dir); in-tree roles spawn the python module
@@ -322,7 +327,7 @@ class ModelManager:
     # reap reasons that are routine lifecycle, not failures — they go in the
     # flight-recorder ring but do not trigger a post-mortem dump
     _GRACEFUL_REAPS = ("stopped by request", "drained for shutdown",
-                      "server shutdown", "single_active_backend")
+                      "server shutdown", "single_active_backend", "preempted")
 
     def _reap(self, h: BackendHandle, reason: str = ""):
         """Remove (if current) + terminate one backend. Safe to call from any
@@ -350,6 +355,36 @@ class ModelManager:
         if h is None:
             return False
         self._reap(h, reason="stopped by request")
+        return True
+
+    def preempt_model(self, name: str, grace: float | None = None) -> bool:
+        """Preemption notice (ISSUE 19): SIGTERM the backend so its server
+        runs the spill-drain fast-path — live slots freeze into ResumeTokens
+        that flush through their open streams — then reap. Unlike
+        `drain_model` this does NOT wait for requests to finish: the point
+        is to checkpoint them mid-flight."""
+        import signal as _signal
+
+        h = self.get(name)
+        if h is None:
+            return False
+        if grace is None:
+            grace = getattr(self.app, "preempt_grace", 0.0) or 0.0
+        from localai_tpu import telemetry
+
+        telemetry.flightrec().record_event("backend_preempt", model=name,
+                                           grace=grace)
+        self.events[(name, "preempt")] += 1
+        if h.alive():
+            h.proc.send_signal(_signal.SIGTERM)
+            try:
+                # spill-drain budget: the grace window plus headroom for the
+                # D2H spills themselves; a wedged child falls through to the
+                # reap's terminate/kill escalation
+                h.proc.wait(timeout=grace + 30.0)
+            except subprocess.TimeoutExpired:
+                pass
+        self._reap(h, reason="preempted")
         return True
 
     def drain_model(self, name: str, timeout: float = 30.0) -> bool:

@@ -235,6 +235,13 @@ class GenRequest:
                                    # arrival instant the SLO layer measures
                                    # queue wait and TTFT from (0 = direct
                                    # construction, falls back to admission)
+    resume: dict | None = None     # preemption resume payload (ISSUE 19,
+                                   # engine/resume.ResumeToken.payload()):
+                                   # prompt_ids is prompt+emitted; "emitted"
+                                   # counts the trailing checkpoint tokens,
+                                   # "key" restores the slot's RNG chain,
+                                   # "sent_chars" suppresses re-emission of
+                                   # text the client already received
 
 
 @dataclasses.dataclass
@@ -252,6 +259,10 @@ class StepOutput:
                                        # to the FINAL chunk only (ISSUE 11;
                                        # None mid-stream or with the SLO
                                        # layer disabled)
+    resume: dict | None = None         # ResumeToken.to_dict() riding the
+                                       # terminal "preempted" chunk — the
+                                       # spill-drain's checkpoint of this
+                                       # request (ISSUE 19); None otherwise
 
 
 @dataclasses.dataclass
@@ -261,6 +272,16 @@ class _Slot:
     out: queue.Queue
     detok: Any                       # _IncrementalDecoder | None
     pending_text: str = ""           # holdback buffer for stop-string scan
+    sent_chars: int = 0              # detok chars released downstream since
+                                     # the ORIGINAL prompt boundary (global
+                                     # across resume segments — the preempt
+                                     # checkpoint's dedup cursor; excludes
+                                     # pending_text, which a resume replays)
+    resume_base: int = 0             # emitted-chain tokens replayed into
+                                     # this slot at resume admission; a
+                                     # second preempt folds them back into
+                                     # the checkpoint's emitted list so
+                                     # resumes compose exactly
     matcher: Any = None              # grammar MatcherState | None
     generated: int = 0
     gen_ids: list[int] = dataclasses.field(default_factory=list)
@@ -546,6 +567,13 @@ class Engine:
         self._running = False
         self._dead = False
         self._thread: threading.Thread | None = None
+        # preemption spill-drain handshake (ISSUE 19): preempt() arms the
+        # request + grace deadline from any thread; the engine thread runs
+        # _spill_drain at a tick boundary and signals done
+        self._preempt_req = threading.Event()
+        self._preempt_done = threading.Event()
+        self._preempt_t = 0.0
+        self._preempt_manifest: list[dict] = []
 
         # metrics (reference MetricsResponse: backend.proto:40-46)
         self.metrics = {
@@ -575,6 +603,12 @@ class Engine:
             "tokens_by_path__ragged": 0,
             "tokens_by_path__spec": 0,
             "tokens_by_path__dense": 0,
+            # preemption-safe serving (ISSUE 19): spill-drains run, blocks
+            # force-spilled, and resume admissions by coverage outcome
+            "preempts": 0,
+            "preempt_spilled_blocks": 0,
+            "resume_readmits": 0,
+            "resume_reprefills": 0,
         }
         if self._draft is not None:
             self.metrics["draft_proposed"] = 0
@@ -707,6 +741,11 @@ class Engine:
         self._blocks_freed = False
         # in-flight D2H spills (hash, group, _AsyncFetch) — dropped on a
         # device-state rebuild: their source buffers died with the error
+        # (the pool claims opened by begin_spill must be abandoned too, or
+        # the chain pins they hold would leak forever)
+        if getattr(self, "_host_pending", None) and self._kvhost is not None:
+            for h, _group, _fetch in self._host_pending:
+                self._kvhost.end_spill(h, None)
         self._host_pending = []
         self._ragged_rr = 0   # ragged decode-row round-robin offset (fair
                               # rotation when the token budget can't hold
@@ -1886,14 +1925,16 @@ class Engine:
             return
         if h is None:
             h = self._block_hash_of.get(pb)
-        if h is None or not self._kvhost.accepts(h):
+        gkey = group if group is not None else self._spill_group
+        # begin_spill claims the hash AND pins the group's resident chain
+        # until _host_drain lands it — an LRU eviction racing the async
+        # copy can no longer free the chain head under its in-flight tail
+        if h is None or not self._kvhost.begin_spill(h, group=gkey):
             return
         t0 = time.perf_counter()
         with activate_mesh(self.mesh):
             arrs = self._spill_fn(self._kc, self._vc, jnp.int32(pb))
-        self._host_pending.append(
-            (h, group if group is not None else self._spill_group,
-             _AsyncFetch(arrs)))
+        self._host_pending.append((h, gkey, _AsyncFetch(arrs)))
         self.metrics["kv_host_spills"] += 1
         if self._sched is not None:
             self._sched.reason("kv_host_spill", block=int(pb))
@@ -1911,8 +1952,8 @@ class Engine:
         evicted = 0
         for h, group, fetch in pending:
             kq, ks, vq, vs = fetch.wait()
-            evicted += self._kvhost.put(
-                h, HostKVBlock(kq=kq, ks=ks, vq=vq, vs=vs), group=group)
+            evicted += self._kvhost.end_spill(
+                h, HostKVBlock(kq=kq, ks=ks, vq=vq, vs=vs))
         if evicted:
             if self._sched is not None:
                 self._sched.reason("kv_host_evict_budget", blocks=evicted)
@@ -2480,6 +2521,30 @@ class Engine:
             chunked = True
             self.metrics["prompt_cache_hits"] += 1
             self.metrics["prompt_tokens_reused"] += lcp
+        if req.resume is not None:
+            # resume outcome attribution (ISSUE 19): every full prefix
+            # block covered by the device/host caches = fast resume; any
+            # uncovered full block pays re-prefill of prompt+emitted
+            if self._paged:
+                from localai_tpu.ops.paged import BLOCK
+
+                full = (min(n - 1, self.ec.max_context - 2
+                            - self._ctx_reserve - 1) // BLOCK) * BLOCK
+                fast = full > 0 and lcp >= full
+            else:
+                fast = lcp > 0
+            self.metrics["resume_readmits" if fast
+                         else "resume_reprefills"] += 1
+            if self._sched is not None:
+                self._sched.reason(
+                    "resume_readmit" if fast else "resume_reprefill",
+                    rid=rid, covered=int(lcp), prompt=int(n))
+            if self._flightrec is not None:
+                self._flightrec.record_event(
+                    "resume", rid=int(rid), covered_tokens=int(lcp),
+                    reprefill_tokens=int(n - lcp),
+                    emitted=int(req.resume.get("emitted", 0)),
+                    outcome="readmit" if fast else "reprefill")
         # token_counts/logit_bias only influence sampling when penalties or a
         # bias are actually set — the common case skips both [V]-sized
         # transfers (~1 MB/admission on a tunneled link)
@@ -2488,6 +2553,11 @@ class Engine:
             or p.presence_penalty != 0.0 or p.frequency_penalty != 0.0
         row = sampler_row(req.params, self.cfg.vocab_size,
                           fallback_seed=rid + 1, include_bias=heavy)
+        if req.resume is not None and req.resume.get("key") is not None:
+            # restore the preempted slot's RNG carry chain: the device key
+            # read back at spill-drain continues the exact split sequence,
+            # so sampled resumes are byte-identical (greedy ignores it)
+            row = dict(row, key=np.asarray(req.resume["key"], np.uint32))
         if heavy:
             counts_row = np.zeros((self.cfg.vocab_size,), np.int32)
             pid, pcnt = np.unique(np.asarray(req.prompt_ids, np.int64),
@@ -2578,6 +2648,45 @@ class Engine:
             else:
                 self._grammar_hostonly += 1
                 self._mask_host[slot] = matcher.mask_bits(eos)
+            if req.resume is not None:
+                # replay the emitted tokens through the automaton so the
+                # PDA (and the device table mirror) resumes mid-grammar
+                # exactly where the preempted slot stopped
+                for t in req.prompt_ids[n - int(req.resume.get(
+                        "emitted", 0)):]:
+                    if not matcher.accept(t):
+                        break
+                    if gbase is not None:
+                        st = int(self._gtrans_np[self._gstate[slot], t])
+                        self._gstate[slot] = st
+                        self._mask_host[slot] = self._gmasks_np[st].view(
+                            np.uint8)[:self._mask_nbytes]
+                    else:
+                        self._mask_host[slot] = matcher.mask_bits(eos)
+        if req.resume is not None:
+            # detokenizer replay: push the emitted chain through the fresh
+            # incremental decoder (identical stream to the preempted run),
+            # suppress the chars the client already received, and hand any
+            # remainder — text the dead backend produced but never
+            # released (stop-string holdback, or chars past the last
+            # flushed chunk) — straight to the stream / holdback buffer
+            cut = n - int(req.resume.get("emitted", 0))
+            slot_obj.resume_base = n - cut
+            replay = ""
+            if slot_obj.detok is not None:
+                for t in req.prompt_ids[cut:]:
+                    replay += slot_obj.detok.push(t)
+            sent = max(0, int(req.resume.get("sent_chars", 0)))
+            leftover = replay[sent:]
+            slot_obj.sent_chars = sent
+            if req.stop:
+                slot_obj.pending_text = leftover
+            elif leftover:
+                slot_obj.sent_chars += len(leftover)
+                out.put(StepOutput(
+                    request_id=rid, text=leftover, token_id=-1,
+                    logprob=0.0, finished=False,
+                    generated_tokens=0, prompt_tokens=n))
         self.metrics["prompt_tokens_processed"] += n - lcp
         if not chunked and self._draft is not None:
             # spec invariant: the first token is sampled (and emitted) at
@@ -3612,6 +3721,13 @@ class Engine:
             # step — drives the _loop restart + flight-recorder post-mortem
             # path in tests; one env dict miss when disarmed
             raise RuntimeError("injected engine_crash (LOCALAI_FAULT)")
+        if self._preempt_req.is_set() and (
+                time.monotonic() >= self._preempt_t
+                or not any(s is not None for s in self._slots)):
+            # grace expired (or nothing left decoding): freeze and spill
+            # every live slot, manifest the queue, keep serving — the
+            # caller owns what happens to the process next
+            self._spill_drain()
         sched = self._sched
         if sched is None and self._flightrec is None:
             return self._step_inner()
@@ -3828,6 +3944,7 @@ class Engine:
             slot.timeline = timings   # _release_slot → flight recorder
             slo.observe("e2e", slot.path or path,
                         now - (slot.req.queued_t or slot.start_time))
+        slot.sent_chars += len(emit_text)
         slot.out.put(StepOutput(
             request_id=slot.request_id, text=emit_text, token_id=token_id,
             logprob=logprob, finished=finish is not None, finish_reason=finish,
@@ -4551,6 +4668,188 @@ class Engine:
             self._thread = None
         if was_serving:
             self._fail_active("cancelled")
+
+    def preempt(self, grace: float = 0.0) -> list[dict]:
+        """Preemption notice (ISSUE 19): freeze every in-flight request,
+        force-spill their KV chains to the host tier, and return a resume
+        manifest (one ResumeToken dict per live/queued request).
+
+        For up to ``grace`` seconds the engine keeps decoding — slots that
+        finish naturally stream their normal terminal chunk — then the
+        spill-drain runs at a tick boundary: each surviving slot gets a
+        terminal StepOutput with finish_reason "preempted" carrying its
+        checkpoint.  Unlike drain_model (wait for idle) or a kill (lose
+        everything), nothing is waited to completion and nothing is lost.
+
+        Safe from any thread; with no loop thread running (generate()/test
+        mode) the drain runs inline.  The engine stays serviceable — a
+        resume may be submitted right back into it."""
+        if self._dead:
+            return []
+        self._preempt_manifest = []
+        self._preempt_done.clear()
+        self._preempt_t = time.monotonic() + max(float(grace), 0.0)
+        if self._thread is not None and self._thread.is_alive():
+            self._preempt_req.set()
+            self._wake.set()
+            self._preempt_done.wait(timeout=max(float(grace), 0.0) + 60.0)
+        else:
+            self._preempt_req.set()
+            while (self._preempt_req.is_set()
+                   and time.monotonic() < self._preempt_t
+                   and any(s is not None for s in self._slots)):
+                self.step()
+            if self._preempt_req.is_set():
+                self._spill_drain()
+        return list(self._preempt_manifest)
+
+    def _spill_drain(self):
+        """Engine-thread half of preempt(): consume the in-flight pipelined
+        dispatch, checkpoint + spill + release every live slot, manifest
+        queued/deferred work, land the spills in the host pool."""
+        from localai_tpu.engine.resume import ResumeToken
+
+        self._preempt_req.clear()
+        t0 = time.perf_counter()
+        if self._pending is not None:
+            self._consume(self._pending)
+            self._pending = None
+        self._prefillq.clear()
+        manifest: list[dict] = []
+        live = [i for i, s in enumerate(self._slots) if s is not None]
+        keys = None
+        if live:
+            try:
+                # explicit sanctioned D2H read (same class as _AsyncFetch
+                # .wait): the per-slot RNG carry keys advance on device per
+                # dispatch, so byte-exact sampled resume needs the real
+                # device values, not a host-side replay from the seed
+                keys = np.asarray(jax.device_get(self._sampler.key))
+            except Exception:
+                keys = None    # greedy-only resume still works
+        now = time.monotonic()
+        spilled_total = 0
+        frozen_rids: set[int] = set()
+        for idx in live:
+            slot = self._slots[idx]
+            if slot is None:
+                continue
+            frozen_rids.add(slot.request_id)
+            tok, spilled = self._freeze_slot(idx, slot, keys, now)
+            spilled_total += spilled
+            manifest.append(tok.to_dict())
+            timings = None
+            if self._slo is not None:
+                timings = self._timeline(slot, "preempted", now)
+                slot.timeline = timings
+            slot.out.put(StepOutput(
+                request_id=slot.request_id, text="", token_id=-1,
+                logprob=0.0, finished=True, finish_reason="preempted",
+                generated_tokens=slot.generated,
+                prompt_tokens=slot.prompt_len,
+                timings=timings, resume=tok.to_dict(),
+            ))
+            if not slot.prefilled:
+                # mid-prefill slot: its block list is only partially
+                # written — take _release_slot's no-retention path (the
+                # shifted branch) so garbage blocks are never registered
+                # in the prefix-cache hash index
+                slot.shifted = max(slot.shifted, 1)
+            self._release_slot(idx, slot)
+        # queued / deferred / mid-admission requests have no device state:
+        # their manifest entries are plain resubmits (emitted=[])
+        waiting = []
+        if self._deferred is not None:
+            waiting.append(self._deferred)
+            self._deferred = None
+        if self._admitting is not None:
+            rid, req, out = self._admitting
+            self._admitting = None
+            if rid not in frozen_rids:   # died before reaching a slot
+                waiting.append((rid, req, out))
+        while True:
+            try:
+                waiting.append(self._queue.get_nowait())
+            except queue.Empty:
+                break
+        for rid, req, out in waiting:
+            tok = ResumeToken(
+                prompt_ids=list(req.prompt_ids), emitted=[],
+                deadline_left=(max(req.deadline - now, 0.0)
+                               if req.deadline else 0.0),
+                request_id=req.trace_id or f"rid-{rid}")
+            manifest.append(tok.to_dict())
+            self._finish_rid(rid)
+            out.put(StepOutput(
+                request_id=rid, text="", token_id=-1, logprob=0.0,
+                finished=True, finish_reason="preempted",
+                prompt_tokens=len(req.prompt_ids),
+                resume=tok.to_dict(),
+            ))
+        self._host_drain()
+        self.metrics["preempts"] += 1
+        self.metrics["preempt_spilled_blocks"] += spilled_total
+        if self._flightrec is not None:
+            self._flightrec.record_event(
+                "preempt", slots=len(live), queued=len(waiting),
+                spilled_blocks=spilled_total,
+                drain_ms=(time.perf_counter() - t0) * 1e3)
+        self._preempt_manifest = manifest
+        self._preempt_done.set()
+
+    def _freeze_slot(self, idx: int, slot: _Slot, keys, now: float):
+        """Checkpoint one live slot into a ResumeToken, force-spilling its
+        full KV chain blocks to the host tier (same eligibility rules as
+        _release_slot's retention: no mm, no shift, no draft, no window)."""
+        from localai_tpu.engine.resume import ResumeToken
+
+        req = slot.req
+        spilled = 0
+        chain_hex: list[str] = []
+        windowed = False
+        if self._tiered:
+            pol = self._slot_policy[idx]
+            windowed = pol is not None and pol.windowed
+        if (self._paged and self.ec.prompt_cache and self._kvhost is not None
+                and slot.prefilled and slot.shifted == 0
+                and req.mm_embeds is None and self._draft is None
+                and not windowed):
+            from localai_tpu.ops.paged import BLOCK
+
+            kept = min(slot.prompt_len + slot.generated,
+                       self.ec.max_context - 2)
+            ids = (list(req.prompt_ids) + slot.gen_ids)[:kept]
+            chain = self._chain_hashes(ids)
+            blocks = self._slot_blocks[idx]
+            group = chain[0] if chain else None
+            for vb, h in enumerate(chain):
+                if vb >= len(blocks):
+                    break
+                self._spill_block(blocks[vb], h=h, group=group)
+                spilled += 1
+                chain_hex.append(h.hex())
+            if spilled and self._sched is not None:
+                self._sched.reason("preempt_spill", slot=int(idx),
+                                   blocks=int(spilled))
+        key = None
+        if keys is not None and not req.params.normalized().greedy:
+            key = [int(k) for k in np.asarray(keys[idx], np.uint32)]
+        # a slot that is itself a resume carries replayed emitted-chain
+        # tokens inside its prompt (resume_base); fold them back into the
+        # checkpoint's emitted list so the ORIGINAL prompt boundary — and
+        # with it detok replay and sent_chars dedup — stays fixed across
+        # any number of preempt/resume rounds
+        cut = slot.prompt_len - slot.resume_base
+        return ResumeToken(
+            prompt_ids=list(req.prompt_ids[:cut]),
+            emitted=list(req.prompt_ids[cut:]) + list(slot.gen_ids),
+            key=key,
+            sent_chars=int(slot.sent_chars),
+            chain=chain_hex,
+            deadline_left=(max(req.deadline - now, 0.0)
+                           if req.deadline else 0.0),
+            request_id=req.trace_id or f"rid-{slot.request_id}",
+        ), spilled
 
     def _fail_active(self, reason: str):
         """Send a terminal StepOutput to every in-flight slot + queued request

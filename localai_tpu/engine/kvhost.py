@@ -83,6 +83,18 @@ class _Group:
     hashes: list = field(default_factory=list)
 
 
+@dataclass
+class _SpillBatch:
+    # one in-flight async spill of a chain group: hashes claimed via
+    # begin_spill but not yet landed/abandoned, plus every hash this batch
+    # pinned (residents at claim time + blocks landed while the batch was
+    # open).  Pins release only when the last claim of the batch ends, so
+    # an LRU eviction racing the spill can never free a chain head out
+    # from under its still-in-flight tail.
+    claims: set = field(default_factory=set)
+    pinned: list = field(default_factory=list)
+
+
 # --------------------------------------------------------------------------
 # the pool
 # --------------------------------------------------------------------------
@@ -117,6 +129,9 @@ class HostKVPool:
         self.evictions = 0       # blocks dropped to respect the budget
         self.rejects = 0         # puts refused (dup / zero budget / pinned)
         self.peak_bytes = 0
+        # in-flight async spills (begin_spill/end_spill): hash -> group key
+        self._pending_h: dict[bytes, bytes] = {}
+        self._spilling: dict[bytes, _SpillBatch] = {}
 
     # -- admission ---------------------------------------------------------
 
@@ -126,7 +141,7 @@ class HostKVPool:
         if self.budget_bytes <= 0:
             return False
         with self._lock:
-            return h not in self._entries
+            return h not in self._entries and h not in self._pending_h
 
     def put(self, h: bytes, block: HostKVBlock,
             group: Optional[bytes] = None) -> int:
@@ -141,19 +156,98 @@ class HostKVPool:
             return 0
         gkey = group if group is not None else h
         with self._lock:
-            if h in self._entries:
+            if h in self._entries or h in self._pending_h:
                 self.rejects += 1
                 return 0
-            self._entries[h] = _Entry(block=block, group=gkey)
-            g = self._groups.get(gkey)
-            if g is None:
-                g = self._groups[gkey] = _Group()
-            g.hashes.append(h)
-            self._groups.move_to_end(gkey)     # MRU
-            self.used_bytes += block.nbytes
-            self.spills += 1
-            self.peak_bytes = max(self.peak_bytes, self.used_bytes)
+            self._land_locked(h, block, gkey)
             return self._evict_to_budget_locked()
+
+    def _land_locked(self, h: bytes, block: HostKVBlock,
+                     gkey: bytes) -> None:
+        self._entries[h] = _Entry(block=block, group=gkey)
+        g = self._groups.get(gkey)
+        if g is None:
+            g = self._groups[gkey] = _Group()
+        g.hashes.append(h)
+        self._groups.move_to_end(gkey)     # MRU
+        self.used_bytes += block.nbytes
+        self.spills += 1
+        self.peak_bytes = max(self.peak_bytes, self.used_bytes)
+
+    # -- in-flight spill claims --------------------------------------------
+
+    def begin_spill(self, h: bytes, group: Optional[bytes] = None) -> bool:
+        """Claim ``h`` for an async D2H spill that will land later via
+        ``end_spill``.  Returns False (and counts a reject) when the pool
+        would refuse the block anyway (zero budget, duplicate, or an
+        identical spill already in flight) so the caller can skip the
+        device->host copy.
+
+        A successful claim opens (or joins) the group's spill batch and
+        pins every block of the group already resident; blocks landed
+        while the batch is open are born pinned too.  All of it unpins
+        when the batch's last claim ends — without this, an LRU eviction
+        between enqueue and drain can free the chain head whose in-flight
+        tail is useless without it.
+        """
+        if self.budget_bytes <= 0:
+            self.rejects += 1
+            return False
+        gkey = group if group is not None else h
+        with self._lock:
+            if h in self._entries or h in self._pending_h:
+                self.rejects += 1
+                return False
+            batch = self._spilling.get(gkey)
+            if batch is None:
+                batch = self._spilling[gkey] = _SpillBatch()
+                g = self._groups.get(gkey)
+                if g is not None:
+                    for rh in g.hashes:
+                        self._entries[rh].pins += 1
+                        batch.pinned.append(rh)
+            batch.claims.add(h)
+            self._pending_h[h] = gkey
+            return True
+
+    def end_spill(self, h: bytes,
+                  block: Optional[HostKVBlock] = None) -> int:
+        """Land (``block`` given) or abandon (``block=None``) a claim made
+        by ``begin_spill``; returns blocks evicted for budget.  Ending a
+        hash that was never claimed degrades to a plain ``put``/no-op so
+        callers keep one unconditional drain path."""
+        with self._lock:
+            gkey = self._pending_h.pop(h, None)
+            if gkey is None:
+                if block is None:
+                    return 0
+                if (self.budget_bytes <= 0
+                        or block.nbytes > self.budget_bytes
+                        or h in self._entries):
+                    self.rejects += 1
+                    return 0
+                self._land_locked(h, block, h)
+                return self._evict_to_budget_locked()
+            batch = self._spilling[gkey]
+            batch.claims.discard(h)
+            evicted = 0
+            if block is not None:
+                if block.nbytes > self.budget_bytes:
+                    self.rejects += 1
+                else:
+                    self._land_locked(h, block, gkey)
+                    self._entries[h].pins += 1     # born pinned
+                    batch.pinned.append(h)
+                    evicted = self._evict_to_budget_locked()
+            if not batch.claims:
+                del self._spilling[gkey]
+                for ph in batch.pinned:
+                    e = self._entries.get(ph)
+                    if e is not None and e.pins > 0:
+                        e.pins -= 1
+                # pins may have deferred evictions the budget needs
+                evicted += self._evict_to_budget_locked()
+            return evicted
 
     def _evict_to_budget_locked(self) -> int:
         evicted = 0
@@ -231,6 +325,7 @@ class HostKVPool:
                 "misses": self.misses,
                 "evictions": self.evictions,
                 "rejects": self.rejects,
+                "pending_spills": len(self._pending_h),
             }
 
     def digest(self, k: int = 128) -> list:

@@ -62,6 +62,12 @@ try:
     _SHED = Counter("localai_shed_total",
                     "Requests shed by admission control or drain",
                     ["model", "reason"])
+    # preemption-safe serving (ISSUE 19): mid-stream resumes by outcome —
+    # "ok" (the resumed stream produced its next chunk), "error" (every
+    # resume lane failed and the terminal SSE error surfaced), "replay"
+    # (deterministic re-issue with prompt+emitted, resume lane disabled)
+    _RESUME = Counter("localai_resume_total",
+                      "Mid-stream preemption resumes", ["model", "outcome"])
     # backend supervision events (spawn retries, respawns, watchdog reaps,
     # breaker rejections) — refreshed from ModelManager.events at scrape;
     # cumulative event counts → Counter (was a mis-typed Gauge)
@@ -279,6 +285,9 @@ class API:
         r.add_get("/debug/sched", self._debug_sched)
         r.add_get("/backend/monitor", self._backend_monitor)
         r.add_post("/backend/shutdown", self._backend_shutdown)
+        # explicit preemption notice (ISSUE 19): spill-drain the model's
+        # backend into resume checkpoints instead of draining to completion
+        r.add_post("/backend/preempt", self._backend_preempt)
         r.add_get("/system", self._system)
         r.add_post("/stores/set", self._stores_set)
         r.add_post("/stores/get", self._stores_get)
@@ -343,9 +352,11 @@ class API:
         rid = request.headers.get("X-Request-Id") or telemetry.new_request_id()
         rid_token = telemetry.set_request_id(rid)
         # work requests are counted for graceful drain and carry a deadline
-        # budget; /backend/shutdown stays admitted (it DRIVES the drain)
+        # budget; /backend/shutdown and /backend/preempt stay admitted
+        # (they DRIVE the drain / spill-drain)
         counted = (request.path not in _OPEN_PATHS
-                   and request.path != "/backend/shutdown")
+                   and request.path not in ("/backend/shutdown",
+                                            "/backend/preempt"))
         dl_token = None
         try:
             if self.cfg.api_keys and request.path not in _OPEN_PATHS:
@@ -565,33 +576,195 @@ class API:
             opts["logprobs"] = True
         return opts
 
+    def _resume_enabled(self, cfg: ModelConfig) -> bool:
+        """The ungraceful-death resume lane rides the host KV tier (ISSUE
+        17): a model without a pool budget keeps the PR 4 contract (terminal
+        SSE error once bytes have streamed), modulo the deterministic-replay
+        fallback."""
+        return bool(getattr(cfg, "kv_host_bytes", 0)
+                    or getattr(self.cfg, "kv_host_bytes", 0))
+
     async def _stream_rpc(self, cfg: ModelConfig, opts: dict):
-        """Supervised streaming call: attempts that fail before ANY chunk
-        reached the client retry transparently on a (re)spawned backend with
-        capped backoff; once bytes have streamed, the failure surfaces —
-        translated (watchdog reap → 504-style message, dead backend → 503)
-        — for the SSE loop to emit as a terminal error event. Each attempt
-        brackets its own busy accounting."""
+        """Supervised streaming call with mid-stream resume (ISSUE 19).
+
+        Attempts that fail before ANY chunk reached the client retry
+        transparently on a (re)spawned backend with capped backoff. Once
+        bytes have streamed, three lanes run before the failure surfaces as
+        the terminal SSE error event:
+
+        - graceful preemption: a terminal ``finish_reason="preempted"``
+          reply carries the engine's full spill-drain ResumeToken; the
+          bridge swallows it, waits out the dying backend, and re-issues
+          the RPC with the token — the respawned engine re-admits the
+          checkpoint (host-pool hit or re-prefill) and the client sees one
+          uninterrupted stream;
+        - ungraceful death with the host KV tier enabled: the bridge
+          synthesizes a token from its own accumulated state (prompt ids
+          from the first chunk's minimal checkpoint, emitted ids, sent
+          chars) and resumes the same way;
+        - deterministic replay (resume lane disabled): temperature-0
+          requests without tools/stop re-issue with ``prompt+emitted`` as
+          the new prompt, holding back a short verification tail whose
+          replayed tokens must match what the client already received —
+          a divergent prefix falls back to the terminal error event.
+        """
         retries = max(0, getattr(self.cfg, "retry_budget", 1))
-        for attempt in range(retries + 1):
+        resume_budget = max(2, retries + 1)
+        prompt_ids: list[int] = [int(t) for t in opts.get("prompt_ids") or []]
+        emitted: list[int] = []      # every token id forwarded downstream
+        sent_chars = 0               # every text char forwarded downstream
+        orig_pt = 0                  # the ORIGINAL request's prompt_tokens
+        base_tokens = 0              # generated count folded into resumes
+        suppress: list[int] = []     # replay verification tail (determ. lane)
+        ckpt: dict | None = None     # full spill-drain ResumeToken
+        resumes = attempt = 0
+        unconfirmed = ""             # resume mode awaiting its first chunk
+        cur = opts
+        while True:
             if attempt:
                 await asyncio.sleep(resilience.backoff(attempt))
             handle = await self._handle(cfg)
             handle.mark_busy()
-            streamed = False
+            streamed = bool(emitted or sent_chars)
+            preempted = False
+            err: Exception | None = None
+            pump = self._pump_stream(handle, cur)
             try:
-                async for reply in self._pump_stream(handle, opts):
+                async for reply in pump:
+                    if reply.resume_json:
+                        try:
+                            d = json.loads(reply.resume_json)
+                        except ValueError:
+                            d = {}
+                        if reply.finish_reason == "preempted":
+                            ckpt = d or None
+                        elif d.get("prompt_ids") and not prompt_ids:
+                            # minimal first-chunk checkpoint: the tokenized
+                            # prompt the resume lanes rebuild prompts from
+                            prompt_ids = [int(t) for t in d["prompt_ids"]]
+                    if unconfirmed:
+                        if _HAVE_PROM and unconfirmed == "resume":
+                            _RESUME.labels(cfg.name, "ok").inc()
+                        unconfirmed = ""
+                    if reply.finish_reason == "preempted":
+                        # swallowed, never forwarded: the resume lane
+                        # continues the stream from the checkpoint
+                        preempted = True
+                        break
+                    if suppress:
+                        # deterministic replay: the verification tail streams
+                        # again first; the client already has these tokens,
+                        # so they are swallowed — and they must MATCH, or the
+                        # replay diverged and the stream cannot be resumed
+                        diverged = bool(reply.finish_reason)
+                        for t in reply.token_ids:
+                            if not suppress or suppress.pop(0) != int(t):
+                                diverged = True
+                                break
+                        if diverged:
+                            raise RuntimeError(
+                                f"deterministic replay diverged for "
+                                f"{cfg.name!r}; cannot resume the stream")
+                        continue
                     streamed = True
+                    for t in reply.token_ids:
+                        emitted.append(int(t))
+                    sent_chars += len(reply.message.decode("utf-8",
+                                                           "replace"))
+                    if reply.prompt_tokens:
+                        if orig_pt:
+                            reply.prompt_tokens = orig_pt
+                        elif not resumes:
+                            orig_pt = reply.prompt_tokens
+                    if base_tokens and reply.tokens:
+                        reply.tokens += base_tokens
                     yield reply
-                return
+                if not preempted:
+                    return
             except grpc.RpcError as e:
-                retriable, err = await asyncio.to_thread(
+                retriable, terr = await asyncio.to_thread(
                     self.manager.classify_failure, handle, e)
-                if streamed or not retriable or attempt >= retries:
-                    raise err from e
-                self.manager.events[(cfg.name, "stream_retry")] += 1
+                if not streamed:
+                    if retriable and attempt < retries:
+                        attempt += 1
+                        self.manager.events[(cfg.name, "stream_retry")] += 1
+                        continue
+                    raise terr from e
+                err = terr
             finally:
+                await pump.aclose()
                 handle.mark_idle()
+            if preempted:
+                # wait out the dying backend before respawning, so the
+                # resume never lands on an engine that is mid-drain
+                await asyncio.to_thread(self.manager.preempt_model, cfg.name)
+            nxt = None
+            if resumes < resume_budget:
+                nxt = self._resume_opts(cfg, opts, prompt_ids, emitted,
+                                        sent_chars, ckpt)
+            if nxt is None:
+                if _HAVE_PROM and (resumes or ckpt is not None):
+                    _RESUME.labels(cfg.name, "error").inc()
+                if err is None:
+                    err = resilience.BackendUnavailable(
+                        f"backend for {cfg.name!r} was preempted mid-stream "
+                        f"and the request could not be resumed")
+                raise err
+            cur, mode, suppress, base_tokens = nxt
+            ckpt = None
+            resumes += 1
+            unconfirmed = mode
+            if _HAVE_PROM and mode == "replay":
+                _RESUME.labels(cfg.name, "replay").inc()
+            self.manager.events[(cfg.name, f"stream_{mode}")] += 1
+            telemetry.flightrec().record_event(
+                "resume", model=cfg.name, mode=mode, emitted=len(emitted),
+                sent_chars=sent_chars, resumes=resumes)
+
+    def _resume_opts(self, cfg: ModelConfig, opts: dict,
+                     prompt_ids: list[int], emitted: list[int],
+                     sent_chars: int, ckpt: dict | None):
+        """Build the re-issued request for a mid-stream resume, or None when
+        no lane applies. Returns (opts, mode, suppress_tail, base_tokens)."""
+        if "images" in opts:
+            # multimodal KV is never frozen (engine skips mm slots) and the
+            # projector embeds can't be rebuilt from token ids alone
+            return None
+        ropts = {k: v for k, v in opts.items()
+                 if k not in ("prompt", "messages_json",
+                              "use_tokenizer_template", "tools_json")}
+        orig_tokens = int(opts.get("tokens") or 128)
+        if ckpt is not None:
+            # graceful spill-drain checkpoint: engine-authoritative
+            ropts["prompt_ids"] = ([int(t) for t in ckpt["prompt_ids"]]
+                                   + [int(t) for t in ckpt["emitted"]])
+            ropts["resume_json"] = json.dumps(ckpt)
+            return ropts, "resume", [], len(ckpt["emitted"])
+        if not prompt_ids or not emitted:
+            return None
+        if self._resume_enabled(cfg):
+            # ungraceful death: synthesize the token from bridge state —
+            # no RNG key (sampled requests resample from a fresh key) and
+            # no chain hashes (the pool died with the process; re-admission
+            # degrades to re-prefill)
+            tok = {"v": 1, "prompt_ids": prompt_ids, "emitted": emitted,
+                   "sent_chars": sent_chars, "generated": len(emitted),
+                   "chain": [], "key": None}
+            ropts["prompt_ids"] = prompt_ids + emitted
+            ropts["resume_json"] = json.dumps(tok)
+            return ropts, "resume", [], len(emitted)
+        if (float(opts.get("temperature") or 0.0) == 0.0
+                and not opts.get("tools_json")
+                and not opts.get("stop_prompts")):
+            # deterministic replay (resume disabled): fold all but a short
+            # verification tail into the prompt; the tail re-generates and
+            # must match what the client already received
+            tail = min(len(emitted), 4)
+            keep = len(emitted) - tail
+            ropts["prompt_ids"] = prompt_ids + emitted[:keep]
+            ropts["tokens"] = max(1, orig_tokens - keep)
+            return ropts, "replay", list(emitted[keep:]), keep
+        return None
 
     async def _pump_stream(self, handle, opts: dict):
         """Bridge the blocking gRPC stream into an async queue."""
@@ -1409,6 +1582,27 @@ class API:
         await self._drain(timeout)
         return web.json_response({"success": True, "draining": True})
 
+    async def _backend_preempt(self, request):
+        """POST /backend/preempt {"model": x, "grace": s} — preemption
+        notice (ISSUE 19): SIGTERM the model's backend so live slots freeze
+        into ResumeTokens (spill-drain) instead of finishing; their streams
+        resume transparently on the respawned backend. Unlike
+        /backend/shutdown this checkpoints requests mid-flight rather than
+        waiting for them."""
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        model = body.get("model", "")
+        if not model:
+            return web.json_response(
+                schema.error_body("model required", code=400), status=400)
+        grace = body.get("grace")
+        ok = await asyncio.to_thread(
+            self.manager.preempt_model, model,
+            float(grace) if grace is not None else None)
+        return web.json_response({"success": ok})
+
     async def _drain(self, timeout: float):
         """Reject new work (middleware 503s while self._draining), wait for
         in-flight requests to finish — hard deadline — then stop backends."""
@@ -1834,6 +2028,7 @@ def run_server(args) -> int:
         breaker_cooldown=getattr(args, "breaker_cooldown", None),
         queue_depth=getattr(args, "queue_depth", None),
         drain_timeout=getattr(args, "drain_timeout", None),
+        preempt_grace=getattr(args, "preempt_grace", None),
         kv_window=getattr(args, "kv_window", None),
         kv_sinks=getattr(args, "kv_sinks", None),
         kv_host_bytes=getattr(args, "kv_host_bytes", None),

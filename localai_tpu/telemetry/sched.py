@@ -104,6 +104,16 @@ REASON_CODES: dict[str, tuple[str, str]] = {
     "kv_host_evict_budget": (
         "kv", "host-tier blocks were dropped (LRU over sessions) to "
         "respect the --kv-host-bytes budget"),
+    "preempt_spill": (
+        "kv", "a live slot's KV chain was force-spilled to the host tier "
+        "by a preemption spill-drain (one count per frozen slot)"),
+    "resume_readmit": (
+        "admission", "a preempted request was re-admitted with its full-"
+        "block KV prefix covered by the device/host caches (fast resume)"),
+    "resume_reprefill": (
+        "admission", "a preempted request resumed without KV coverage "
+        "(host pool disabled, evicted, or budget too small) and fell back "
+        "to re-prefilling prompt+emitted"),
     "budget_cap": (
         "pack", "the ragged token budget filled; remaining decode rows or "
         "prefill chunks wait for the next tick"),

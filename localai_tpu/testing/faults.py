@@ -10,6 +10,15 @@ list of fault specs, each `kind[:arg[:limit[:target]]]`:
     `unavailable`   Predict/PredictStream aborts with gRPC UNAVAILABLE
     `deadline`      Predict/PredictStream aborts with DEADLINE_EXCEEDED
     `stall_stream`  PredictStream sleeps `arg` seconds after its first chunk
+    `preempt`       backend raises SIGTERM against itself after the first
+                    emitted token of a stream — the preemption-notice
+                    fast-path (ISSUE 19): the engine spill-drains live slots
+                    into ResumeTokens before the process stops (arg = grace
+                    seconds the drain lets slots keep running)
+    `kill9_middecode`  backend SIGKILLs itself at the `arg`-th emitted token
+                    of a stream (default 1) — ungraceful death mid-decode:
+                    no drain, no checkpoint; the HTTP bridge must resume
+                    from its own accumulated stream state
 - `arg`: float parameter (seconds / exit code); default 0.
 - `limit`: inject at most N times; empty = unlimited. Counting is shared
   across processes when `LOCALAI_FAULT_DIR` points at a directory (one

@@ -38,6 +38,10 @@ RATIO_KEYS = (
     # re-admission TTFT — the self-relative speedup the host KV tier buys;
     # a re-admission regression shrinks it
     "readmit_speedup",
+    # --mode session (ISSUE 19): re-prefill TTFT over survivor-pool resume
+    # TTFT after a mid-decode preempt — the speedup the spill-drain
+    # checkpoint buys; a resume-path regression shrinks it
+    "resume_speedup",
     "budget_utilization", "draft_acceptance", "mfu", "stage_coverage",
 )
 # lower is better; gate when NEW exceeds threshold-scaled OLD.

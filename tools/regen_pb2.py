@@ -37,6 +37,15 @@ EDITS = [
     # llama.cpp-style `timings` block in the last SSE chunk
     ("Reply", "timings_json", 9,
      descriptor_pb2.FieldDescriptorProto.TYPE_STRING),
+    # preemption-safe serving (ISSUE 19): a resume request carries its
+    # ResumeToken here (prompt+emitted resubmit with RNG/dedup fixups)...
+    ("PredictOptions", "resume_json", 28,
+     descriptor_pb2.FieldDescriptorProto.TYPE_STRING),
+    # ...and streamed replies carry checkpoints back: the FIRST chunk a
+    # minimal {"v","prompt_ids"} (deterministic-replay fallback), the
+    # terminal "preempted" chunk the full spill-drain token
+    ("Reply", "resume_json", 10,
+     descriptor_pb2.FieldDescriptorProto.TYPE_STRING),
 ]
 
 # (method name, input message, output message, server_streaming) — added to
