@@ -70,6 +70,10 @@ class LLMServicer(BackendServicer):
                 return pb.Result(success=True, message="already loaded")
             self._state = pb.StatusResponse.BUSY
             try:
+                # lockdep: allow(lock-blocking) — the load lock serializes
+                # the WHOLE load (weights + engine start + warmup compiles +
+                # prewarm streams, minutes of blocking): that is its job.
+                # It is the backend process's outermost lock (rank 0)
                 self._load(request)
                 self._state = pb.StatusResponse.READY
                 return pb.Result(success=True, message="ok")

@@ -35,6 +35,7 @@ from localai_tpu.core.resilience import (
     BackendUnavailable, CircuitBreaker, DeadlineExceeded, WatchdogReaped,
     backoff,
 )
+from localai_tpu.testing.lockdep import lockdep_lock
 
 
 def free_port() -> int:
@@ -66,7 +67,8 @@ class BackendHandle:
                                   # in-flight requests that now fail their
                                   # RPC surface THIS instead of a raw
                                   # severed-channel grpc error
-    _lock: threading.Lock = field(default_factory=threading.Lock)
+    _lock: threading.Lock = field(
+        default_factory=lambda: lockdep_lock("manager.handle"))
 
     def alive(self) -> bool:
         return self.proc.poll() is None
@@ -94,8 +96,9 @@ class ModelManager:
     def __init__(self, app: AppConfig):
         self.app = app
         self._models: dict[str, BackendHandle] = {}
-        self._lock = threading.Lock()          # guards the maps only — never
-                                               # held across spawn/health/RPC
+        self._lock = lockdep_lock("manager.map")  # guards the maps only —
+                                               # never held across
+                                               # spawn/health/RPC
         self._model_locks: dict[str, threading.Lock] = {}
         self._breakers: dict[str, CircuitBreaker] = {}
         # supervision telemetry: (model, event) → count, scraped into the
@@ -108,7 +111,8 @@ class ModelManager:
         with self._lock:
             lk = self._model_locks.get(name)
             if lk is None:
-                lk = self._model_locks[name] = threading.Lock()
+                lk = self._model_locks[name] = lockdep_lock(
+                    "manager.model", per_key=True)
             return lk
 
     def breaker(self, name: str) -> CircuitBreaker:
@@ -294,6 +298,9 @@ class ModelManager:
                     h.last_used = time.monotonic()
                     br.record_success()
                     return h
+                # lockdep: allow(lock-blocking) — reap of the dead handle
+                # (proc.wait) stays under the per-MODEL lock so the respawn
+                # below can't race a half-dead predecessor
                 self._reap(h, reason="dead backend found at load")
                 self.events[(cfg.name, "reap_dead")] += 1
             if self.app.single_active_backend:
@@ -301,14 +308,26 @@ class ModelManager:
                     others = [o for o in self._models.values()
                               if o.name != cfg.name]
                 for other in others:
+                    # lockdep: allow(lock-blocking) — evicting the previous
+                    # backend (proc.wait) must finish before this model's
+                    # load proceeds; only same-model loads wait on us
                     self._reap(other, reason="single_active_backend")
             h = None
             try:
+                # lockdep: allow(lock-blocking) — spawn + health poll + the
+                # load RPC run under the per-MODEL lock on purpose: this IS
+                # the load-serialization point (PR 4 moved the blocking off
+                # the map lock, not off this one)
                 h = self._spawn(cfg)
+                # lockdep: allow(lock-blocking) — same: load RPC serialized
+                # per model by design
                 self._load_rpc(h)
             except Exception:
                 br.record_failure()
                 if h is not None:
+                    # lockdep: allow(lock-blocking) — reaping the failed
+                    # spawn (proc.wait) before releasing the load lock keeps
+                    # the port/process accounting consistent for the retry
                     self._reap(h, reason="load failed")
                 raise
             br.record_success()

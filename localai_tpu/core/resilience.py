@@ -20,6 +20,8 @@ import contextvars
 import threading
 import time
 
+from localai_tpu.testing.lockdep import lockdep_lock
+
 
 # --------------------------------------------------------------- errors
 
@@ -88,7 +90,7 @@ class CircuitBreaker:
         self.cooldown = float(cooldown)
         self.name = name            # flight-recorder label ("" = anonymous)
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = lockdep_lock("breaker")
         self._state = self.CLOSED
         self._failures = 0
         self._opened_at = 0.0

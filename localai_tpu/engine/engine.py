@@ -55,6 +55,7 @@ from localai_tpu.ops.sampling import (
 )
 from localai_tpu.parallel.mesh import activate_mesh
 from localai_tpu.testing import faults
+from localai_tpu.testing.lockdep import lockdep_lock
 
 
 @dataclasses.dataclass(frozen=True)
@@ -561,8 +562,8 @@ class Engine:
         # and a one-tick-late observation only costs one extra token.
         self._cancelled: set[int] = set()
         self._live: set[int] = set()   # rids submitted but not yet terminal
-        self._lock = threading.Lock()
-        self._grammar_lock = threading.Lock()
+        self._lock = lockdep_lock("engine.submit")
+        self._grammar_lock = lockdep_lock("engine.grammar")
         self._wake = threading.Event()
         self._running = False
         self._dead = False
@@ -1333,7 +1334,6 @@ class Engine:
         exactly once and the upload is off the per-token hot path."""
         if self._gtab_dirty:
             with activate_mesh(self.mesh):
-                # lint: allow(host-sync-cast) — one-time table upload
                 self._gmasks_dev = jnp.asarray(self._gmasks_np)
                 self._gtrans_dev = jnp.asarray(self._gtrans_np)
             self._gtab_dirty = False

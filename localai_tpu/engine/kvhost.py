@@ -40,6 +40,8 @@ from typing import Optional
 
 import numpy as np
 
+from localai_tpu.testing.lockdep import lockdep_lock
+
 __all__ = [
     "HostKVBlock", "HostKVPool", "PrefixDigest",
     "text_chain_ids", "body_prompt_text",
@@ -117,7 +119,7 @@ class HostKVPool:
 
     def __init__(self, budget_bytes: int):
         self.budget_bytes = int(budget_bytes)
-        self._lock = threading.Lock()
+        self._lock = lockdep_lock("kvhost.pool")
         self._entries: dict[bytes, _Entry] = {}
         # insertion/touch order == LRU order (oldest first)
         self._groups: "OrderedDict[bytes, _Group]" = OrderedDict()
@@ -412,7 +414,7 @@ class PrefixDigest:
 
     def __init__(self, cap: int = 1024):
         self.cap = int(cap)
-        self._lock = threading.Lock()
+        self._lock = lockdep_lock("kvhost.digest")
         self._ids: "OrderedDict[str, None]" = OrderedDict()
 
     def add(self, ids: list) -> None:

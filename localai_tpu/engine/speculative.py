@@ -96,15 +96,13 @@ class SpeculativeDecoder:
         draft_done = n                   # committed positions in draft cache
 
         def sample_from(logits):
-            # lint: allow(host-sync-cast, host-sync-asarray) — this class IS
-            # the host-driven reference decoder (per-token syncs by design);
-            # the production fused path is engine/spec.py
+            # this class IS the host-driven reference decoder (per-token
+            # syncs by design); the production fused path is engine/spec.py
             if temperature <= 0:
                 # lint: allow(host-sync-cast)
                 return int(jnp.argmax(logits))
             # lint: allow(host-sync-asarray)
             p = np.asarray(jax.nn.softmax(logits / temperature))
-            # lint: allow(host-sync-cast)
             return int(rng.choice(len(p), p=p / p.sum()))
 
         while len(out) < max_tokens:

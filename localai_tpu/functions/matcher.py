@@ -17,6 +17,7 @@ import threading
 import numpy as np
 
 from localai_tpu.native import build_and_load
+from localai_tpu.testing.lockdep import lockdep_lock
 
 
 @functools.lru_cache(maxsize=8)
@@ -153,7 +154,7 @@ class CompiledGrammar:
             self.vocab_size)
         self._lib = lib
         self._tables: dict[int, GrammarTable | None] = {}
-        self._tables_lock = threading.Lock()
+        self._tables_lock = lockdep_lock("matcher.tables")
 
     def state(self) -> "MatcherState":
         return MatcherState(self)
@@ -206,7 +207,7 @@ class GrammarCache:
     def __init__(self, tok):
         self._texts = token_texts(tok)
         self._cache: dict[str, CompiledGrammar] = {}
-        self._lock = threading.Lock()
+        self._lock = lockdep_lock("matcher.cache")
 
     def get(self, gbnf: str) -> CompiledGrammar:
         with self._lock:

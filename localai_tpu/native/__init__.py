@@ -12,8 +12,10 @@ import os
 import subprocess
 import threading
 
+from localai_tpu.testing.lockdep import lockdep_lock
+
 _HERE = os.path.dirname(__file__)
-_LOCK = threading.Lock()
+_LOCK = lockdep_lock("native.build")
 _LIBS: dict[str, ctypes.CDLL] = {}
 
 

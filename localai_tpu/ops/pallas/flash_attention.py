@@ -509,9 +509,9 @@ def pallas_works(num_heads: int = 4, num_kv_heads: int = 2,
         return True
 
     def _probe():
-        # lint: allow(sync-block-until-ready) — load-time tier probe: the
-        # fences ARE the point (prove each kernel lowers+runs on this chip
-        # before serving starts); never on a request path
+        # load-time tier probe: the block_until_ready fences ARE the point
+        # (prove each kernel lowers+runs on this chip before serving
+        # starts); never on a request path
         B, S, T = 1, 256, 512
         q = jnp.zeros((B, S, num_heads, head_dim), dtype)
         kv = jnp.zeros((B, S, num_kv_heads, head_dim), dtype)

@@ -135,6 +135,9 @@ class Replicator:
         payload = pickle.dumps((op, kwargs), protocol=pickle.HIGHEST_PROTOCOL)
         with self._lock:
             for c in self._conns:
+                # lockdep: allow(lock-blocking) — sendall under the lock is
+                # the broadcast ordering guarantee: every follower sees ops
+                # in one global order; the leaf lock acquires nothing else
                 _send_msg(c, payload)
 
     def close(self):

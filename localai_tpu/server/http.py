@@ -28,6 +28,7 @@ from localai_tpu.config import AppConfig, ModelConfig, ModelConfigLoader
 from localai_tpu.core import resilience
 from localai_tpu.core.manager import ModelManager
 from localai_tpu.server import schema
+from localai_tpu.testing.lockdep import lockdep_lock
 
 try:
     from prometheus_client import (
@@ -312,7 +313,7 @@ class API:
         self.gallery_service = None  # wired by run_server when galleries set
         self.backend_gallery_service = None  # ditto (backend registry)
         self._mcp_sessions: dict[str, list] = {}   # model → MCP sessions
-        self._mcp_lock = threading.Lock()
+        self._mcp_lock = lockdep_lock("http.mcp")
         # resilience state (ISSUE 4): per-model admission gates, the drain
         # flag the middleware turns into 503s, and the live-request count
         # graceful shutdown waits on
@@ -1379,12 +1380,19 @@ class API:
         sessions = sessions_from_config(cfg.mcp)
         with self._mcp_lock:
             existing = self._mcp_sessions.get(cfg.name)
-            if existing is not None:     # lost the race: keep the first set
-                for s in sessions:
-                    s.close()
-                return existing
-            self._mcp_sessions[cfg.name] = sessions
-            return sessions
+            if existing is None:
+                self._mcp_sessions[cfg.name] = sessions
+                return sessions
+        # lost the race: keep the first set, and close OUR spawned
+        # sessions outside the lock — close() terminates the server
+        # process and waits on it (lockdep flagged the old in-lock close:
+        # a wedged MCP server would have blocked every model's MCP path)
+        for s in sessions:
+            try:
+                s.close()
+            except Exception:
+                pass
+        return existing
 
     def _mcp_evict(self, name: str):
         """Drop (and close) a model's cached MCP sessions — called when a

@@ -40,6 +40,7 @@ import threading
 import time
 
 from localai_tpu.telemetry.profiler import BUCKETS_S
+from localai_tpu.testing.lockdep import lockdep_lock
 
 # SLO metric names the engine records (seconds); the fixed set keeps the
 # flat()/parse round-trip unambiguous and the exposition surfaces stable
@@ -114,7 +115,7 @@ class SLORegistry:
 
     def __init__(self):
         self._hists: dict[tuple[str, str], Hist] = {}
-        self._lock = threading.Lock()
+        self._lock = lockdep_lock("telemetry.slo")
 
     def observe(self, metric: str, path: str, v: float, n: int = 1):
         h = self._hists.get((metric, path))
@@ -225,7 +226,7 @@ def _quantiles_ms(h: Hist) -> dict:
 # ------------------------------------------------------- process singleton
 
 _SLO: SLORegistry | None = None
-_SLO_LOCK = threading.Lock()
+_SLO_LOCK = lockdep_lock("telemetry.slo_init")
 
 
 def maybe_slo() -> SLORegistry | None:
@@ -260,7 +261,7 @@ class FlightRecorder:
         self.requests: collections.deque = collections.deque(maxlen=requests)
         self.ticks: collections.deque = collections.deque(maxlen=ticks)
         self.events: collections.deque = collections.deque(maxlen=events)
-        self._lock = threading.Lock()
+        self._lock = lockdep_lock("telemetry.flightrec")
         self._dumps = 0
         self.last_dump_path = ""
 
@@ -320,7 +321,7 @@ class FlightRecorder:
 
 
 _FLIGHTREC: FlightRecorder | None = None
-_FLIGHTREC_LOCK = threading.Lock()
+_FLIGHTREC_LOCK = lockdep_lock("telemetry.flightrec_init")
 
 
 def flightrec() -> FlightRecorder:

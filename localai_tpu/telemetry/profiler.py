@@ -27,6 +27,8 @@ import os
 import threading
 import time
 
+from localai_tpu.testing.lockdep import lockdep_lock
+
 # histogram bucket upper bounds, in seconds (log-spaced 50 µs … 5 s + inf)
 BUCKETS_S: tuple[float, ...] = (
     50e-6, 100e-6, 200e-6, 500e-6, 1e-3, 2e-3, 5e-3, 10e-3, 20e-3, 50e-3,
@@ -122,7 +124,7 @@ class StepProfiler:
         self._stages: dict[str, _Stage] = {}
         self._gauges: dict[str, float] = {}
         self._costs: dict[str, dict] = {}
-        self._lock = threading.Lock()
+        self._lock = lockdep_lock("telemetry.profiler")
         self._first_t: float | None = None
         self._last_t: float = 0.0
 

@@ -37,6 +37,7 @@ import time
 from collections import deque
 
 from localai_tpu.telemetry.metrics import metrics_enabled
+from localai_tpu.testing.lockdep import lockdep_lock
 
 # --------------------------------------------------------------- reason codes
 # code -> (category, description). Categories:
@@ -261,7 +262,7 @@ class TickLedger:
         # the AOT cost-analysis pass; flat()/snapshot() then export them)
         self.rooflines: dict[str, dict] = {}
         self._cur: dict | None = None
-        self._lock = threading.Lock()
+        self._lock = lockdep_lock("telemetry.sched")
 
     def reset(self) -> None:
         """Drop accumulated ticks/counters (NOT the cached rooflines) — the

@@ -33,6 +33,8 @@ import threading
 import time
 import uuid
 
+from localai_tpu.testing.lockdep import lockdep_lock
+
 # perf_counter → wall-clock rebasing (one constant per process): Chrome-trace
 # `ts` fields from different processes line up on the same timeline
 _EPOCH_US = time.time_ns() // 1000 - time.perf_counter_ns() // 1000
@@ -166,7 +168,7 @@ class Tracer:
 
 
 _TRACER: Tracer | None = None
-_TRACER_LOCK = threading.Lock()
+_TRACER_LOCK = lockdep_lock("telemetry.tracer_init")
 
 
 def tracer() -> Tracer:

@@ -1,12 +1,15 @@
-"""Test-support package: deterministic fault injection (`testing.faults`)
-and the runtime perf tripwires (`testing.tripwires`).
+"""Test-support package: deterministic fault injection (`testing.faults`),
+the runtime perf tripwires (`testing.tripwires`), and the lock-dependency
+tripwire + schedule perturber (`testing.lockdep`).
 
 Shipped inside the package (not under tests/) because the injection points
 live in production modules — the backend entrypoint and the LLM servicer
 call `faults.fire(...)` at their hazard points, the engine reads
-`tripwires.decode_guard_level()` at construction — and those hooks must
-resolve in spawned subprocesses too. With `LOCALAI_FAULT` /
-`LOCALAI_TRANSFER_GUARD` unset every hook is a dict/env lookup returning
-None-or-empty.
+`tripwires.decode_guard_level()` at construction, every serving-critical
+lock is created through `lockdep.lockdep_lock(name)` — and those hooks
+must resolve in spawned subprocesses too. With `LOCALAI_FAULT` /
+`LOCALAI_TRANSFER_GUARD` / `LOCALAI_LOCKDEP` unset every hook is a
+dict/env lookup returning None-or-empty (lockdep_lock hands back the raw
+threading.Lock untouched).
 """
-from localai_tpu.testing import faults, tripwires  # noqa: F401
+from localai_tpu.testing import faults, lockdep, tripwires  # noqa: F401

@@ -1,0 +1,409 @@
+"""Runtime lock-dependency tripwire + schedule-perturbing race harness.
+
+The dynamic half of ``tools/lockdep`` (the static whole-program lock-order
+analyzer).  Three pieces:
+
+- ``lockdep_lock(name, lock=None)``: the registration point.  Every
+  serving-critical lock in the tree is created through it with a stable
+  hierarchy name (``"manager.map"``, ``"kvhost.pool"``, ...) — the same
+  names ``tools/lockdep/hierarchy.py`` ranks.  With ``LOCALAI_LOCKDEP``
+  unset this returns the raw ``threading.Lock`` untouched (zero overhead,
+  same pattern as ``LOCALAI_TRANSFER_GUARD``); when set, the lock comes
+  back wrapped in a :class:`LockdepLock` that records per-thread held-sets
+  and the global observed acquisition-order graph.
+
+- the tripwire itself: on every acquire the wrapper checks whether the
+  lock being taken can already *reach* any currently-held lock in the
+  observed order graph (transitive — A→B→C recorded, now C is taken while
+  A... wait, while holding C someone takes A).  The first inversion — or a
+  hold exceeding ``LOCALAI_LOCKDEP_HOLD_MS`` — raises
+  :class:`LockdepViolation` carrying BOTH stacks (the current acquire and
+  the first observation of the conflicting order), flight-recorded as a
+  ``lockdep_inversion`` event.  ``LOCALAI_LOCKDEP=record`` flight-records
+  and accumulates in :func:`violations` instead of raising, so a whole
+  chaos suite can run as one lockdep probe.
+
+- ``perturb_schedule(seed)``: a seeded scheduling fuzzer for the ``races``
+  pytest lane — shrinks ``sys.setswitchinterval`` and injects randomized
+  pre-acquire yields/sleeps through the same wrappers, so latent orderings
+  that only appear under unlucky preemption get flushed out in-test
+  instead of in production.
+
+Edge identity is by lock *name* (lockdep's "lock class" semantics): two
+engines' ``engine.submit`` locks share one node, so an ordering proven bad
+on any instance pair trips on every instance pair.  Same-instance
+re-acquire on a non-reentrant lock is a certain deadlock and raises even
+in record mode (proceeding would hang the probe).
+
+Stdlib-only, imports nothing from the package at module level — telemetry
+is reached lazily on the violation path only (telemetry modules create
+their own locks through here).
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import random
+import sys
+import threading
+import time
+import traceback
+
+__all__ = [
+    "LockdepLock", "LockdepViolation", "lockdep_lock", "lockdep_mode",
+    "set_lockdep_mode", "hold_threshold_ms", "set_hold_threshold_ms",
+    "perturb_schedule", "violations", "reset_lockdep", "held_names",
+    "order_graph",
+]
+
+
+class LockdepViolation(AssertionError):
+    """A lock-order inversion, same-lock re-acquire, or hold-time trip.
+
+    ``kind`` is one of ``"inversion"``, ``"self-deadlock"``, ``"hold"``;
+    ``report`` is the full two-stack human-readable report.
+    """
+
+    def __init__(self, kind: str, report: str):
+        super().__init__(report)
+        self.kind = kind
+        self.report = report
+
+
+# ---------------------------------------------------------------- mode gate
+
+_MODE: str | None = None       # None = read env; set_lockdep_mode overrides
+_HOLD_MS: float | None = None  # None = read env
+
+
+def lockdep_mode() -> str:
+    """"" (disabled), "raise", or "record" — from LOCALAI_LOCKDEP ("1" is
+    shorthand for "raise"), overridable via set_lockdep_mode for tests."""
+    if _MODE is not None:
+        return _MODE
+    val = os.environ.get("LOCALAI_LOCKDEP", "").strip().lower()
+    if val in ("", "0"):
+        return ""
+    if val in ("1", "raise"):
+        return "raise"
+    if val == "record":
+        return "record"
+    return "raise"     # any other truthy value errs on the loud side
+
+
+def set_lockdep_mode(mode: str | None) -> None:
+    """Test hook: "" / "raise" / "record", or None to fall back to the
+    environment.  Locks created while disabled stay raw — enable BEFORE
+    constructing the objects under test."""
+    global _MODE
+    _MODE = mode
+
+
+def hold_threshold_ms() -> float:
+    """Hold-time trip threshold (0 = hold checking off)."""
+    if _HOLD_MS is not None:
+        return _HOLD_MS
+    try:
+        return float(os.environ.get("LOCALAI_LOCKDEP_HOLD_MS", "0") or 0)
+    except ValueError:
+        return 0.0
+
+
+def set_hold_threshold_ms(ms: float | None) -> None:
+    global _HOLD_MS
+    _HOLD_MS = ms
+
+
+# ------------------------------------------------------------- global state
+
+# The tripwire's own bookkeeping runs under ONE raw (uninstrumented) lock;
+# everything inside it is dict/list work — never a blocking call, never a
+# wrapped lock.
+_graph_lock = threading.Lock()
+# (held_name, acquired_name) -> formatted stack of the FIRST observation
+_edges: dict[tuple[str, str], str] = {}
+_violations: list[dict] = []
+_tls = threading.local()
+# perturbation state: (random.Random, max_delay_us) or None
+_PERTURB: tuple | None = None
+
+
+def _held_stack() -> list:
+    st = getattr(_tls, "held", None)
+    if st is None:
+        st = _tls.held = []
+    return st
+
+
+def held_names() -> list:
+    """Names of the locks the CURRENT thread holds (outermost first)."""
+    return [h[0].name for h in _held_stack()]
+
+
+def order_graph() -> dict:
+    """Snapshot of the observed acquisition-order edges
+    {(held, acquired): first-observation stack}."""
+    with _graph_lock:
+        return dict(_edges)
+
+
+def violations() -> list:
+    """Violations accumulated in record mode (each a dict with kind/
+    names/report)."""
+    with _graph_lock:
+        return list(_violations)
+
+
+def reset_lockdep() -> None:
+    """Drop the observed order graph and recorded violations (held-sets of
+    live threads are untouched)."""
+    global _PERTURB
+    with _graph_lock:
+        _edges.clear()
+        _violations.clear()
+    _PERTURB = None
+
+
+def _reaches(src: str, dst: str) -> bool:
+    """Is there a path src -> ... -> dst in the observed edge graph?
+    Caller holds _graph_lock."""
+    seen = {src}
+    stack = [src]
+    while stack:
+        cur = stack.pop()
+        if cur == dst:
+            return True
+        for (a, b) in _edges:
+            if a == cur and b not in seen:
+                seen.add(b)
+                stack.append(b)
+    return False
+
+
+def _first_stack(src: str, dst: str) -> str:
+    """The stored stack proving some path src -> dst (direct edge when
+    present, else the first hop of a path).  Caller holds _graph_lock."""
+    direct = _edges.get((src, dst))
+    if direct is not None:
+        return direct
+    for (a, b), stk in _edges.items():
+        if a == src and _reaches(b, dst):
+            return stk
+    return "(stack of the prior ordering was not retained)"
+
+
+def _report(kind: str, title: str, prior_stack: str | None) -> None:
+    """Build the two-stack report, flight-record it, then raise or
+    accumulate per mode.  Never called with _graph_lock held."""
+    here = "".join(traceback.format_stack(sys._getframe(2)))
+    lines = [f"lockdep {kind}: {title}",
+             "", "--- this acquisition ---", here]
+    if prior_stack is not None:
+        lines += ["--- first observation of the conflicting order ---",
+                  prior_stack]
+    report = "\n".join(lines)
+    entry = {"kind": kind, "title": title, "report": report}
+    try:
+        from localai_tpu import telemetry
+
+        telemetry.flightrec().record_event(
+            "lockdep_inversion", lockdep_kind=kind, title=title)
+    except Exception:
+        pass   # the tripwire must work in processes without telemetry wiring
+    mode = lockdep_mode()
+    if mode == "record" and kind != "self-deadlock":
+        with _graph_lock:
+            _violations.append(entry)
+        return
+    raise LockdepViolation(kind, report)
+
+
+# ------------------------------------------------------------- the wrapper
+
+class LockdepLock:
+    """A named, order-checked wrapper around a real threading lock.
+
+    Delegates acquire/release; before each acquire it (a) applies the
+    active schedule perturbation, (b) checks the acquisition against the
+    per-thread held-set and the global observed-order graph; after each
+    release it checks the hold time.  Supports the full context-manager
+    and acquire/release surface the wrapped lock exposes.
+    """
+
+    __slots__ = ("name", "_lock", "_reentrant", "_per_key")
+
+    def __init__(self, name: str, lock=None, reentrant: bool = False,
+                 per_key: bool = False):
+        self.name = name
+        self._lock = lock if lock is not None else threading.Lock()
+        self._reentrant = reentrant
+        self._per_key = per_key
+
+    # -- checks ------------------------------------------------------------
+
+    def _pre_acquire(self) -> None:
+        p = _PERTURB
+        if p is not None:
+            rng, max_us = p
+            r = rng.random()
+            if r < 0.5:
+                time.sleep(0.0)                  # bare yield
+            else:
+                time.sleep(r * max_us / 1e6)
+        held = _held_stack()
+        if not held:
+            return
+        for hlock, _t0, _stk in held:
+            if hlock is self._lock or hlock is self:
+                if self._reentrant:
+                    return      # RLock re-entry: no new ordering information
+                _report("self-deadlock",
+                        f"re-acquiring non-reentrant lock {self.name!r} "
+                        f"already held by this thread — certain deadlock",
+                        None)
+                return
+        prior = None
+        conflict = None
+        with _graph_lock:
+            for hlock, _t0, _stk in held:
+                hname = hlock.name if isinstance(hlock, LockdepLock) \
+                    else str(hlock)
+                if hname == self.name:
+                    conflict = (hname, "same-class")
+                    prior = None
+                    break
+                if _reaches(self.name, hname):
+                    conflict = (hname, "inversion")
+                    prior = _first_stack(self.name, hname)
+                    break
+        if conflict is None:
+            return
+        hname, why = conflict
+        if why == "same-class":
+            _report("inversion",
+                    f"acquiring {self.name!r} while already holding another "
+                    f"lock of the same class {hname!r} — per-key/instance "
+                    f"locks of one class must never nest (ABBA between "
+                    f"threads)", None)
+        else:
+            _report("inversion",
+                    f"acquiring {self.name!r} while holding {hname!r}, but "
+                    f"the reverse order {self.name!r} -> ... -> {hname!r} "
+                    f"was already observed — lock-order inversion "
+                    f"(potential deadlock)", prior)
+
+    def _post_acquire(self) -> None:
+        held = _held_stack()
+        need_stack = hold_threshold_ms() > 0
+        my_stack = ("".join(traceback.format_stack(sys._getframe(2)))
+                    if need_stack else "")
+        new_edges = []
+        with _graph_lock:
+            for hlock, _t0, _stk in held:
+                if not isinstance(hlock, LockdepLock):
+                    continue
+                key = (hlock.name, self.name)
+                if key not in _edges and hlock.name != self.name:
+                    new_edges.append(key)
+            for key in new_edges:
+                # capture the stack proving this order, once per edge
+                _edges[key] = "".join(
+                    traceback.format_stack(sys._getframe(1)))
+        held.append((self, time.perf_counter(), my_stack))
+
+    def _pop_held(self):
+        """Drop this lock from the thread's held stack; return the hold-time
+        trip (title string) if the hold exceeded the threshold, else None.
+        Never raises — the caller must release the real lock FIRST, then
+        report, or a raise-mode trip would leave it held forever."""
+        held = _held_stack()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] is self:
+                _lock, t0, stk = held.pop(i)
+                thr = hold_threshold_ms()
+                if thr > 0:
+                    dt_ms = (time.perf_counter() - t0) * 1e3
+                    if dt_ms > thr:
+                        return (f"lock {self.name!r} held for "
+                                f"{dt_ms:.1f} ms (threshold {thr:.1f} ms)"
+                                + (f"\n--- acquired at ---\n{stk}"
+                                   if stk else ""))
+                return None
+        return None
+
+    # -- lock surface ------------------------------------------------------
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        self._pre_acquire()
+        got = self._lock.acquire(blocking, timeout)
+        if got:
+            self._post_acquire()
+        return got
+
+    def release(self):
+        trip = self._pop_held()
+        self._lock.release()
+        if trip is not None:
+            _report("hold", trip, None)
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"<LockdepLock {self.name!r} wrapping {self._lock!r}>"
+
+
+def lockdep_lock(name: str, lock=None, per_key: bool = False):
+    """Create (or wrap) a lock registered under a hierarchy ``name``.
+
+    Disabled (the default): returns ``lock`` — or a fresh
+    ``threading.Lock()`` when none is given — completely untouched.
+    Enabled (``LOCALAI_LOCKDEP`` / :func:`set_lockdep_mode`): returns a
+    :class:`LockdepLock` enforcing the observed acquisition order.
+
+    ``name`` should match an entry in ``tools/lockdep/hierarchy.py`` so
+    the static and runtime layers talk about the same lock classes.
+    """
+    if lock is None:
+        lock = threading.Lock()
+    if not lockdep_mode():
+        return lock
+    reentrant = type(lock).__name__ in ("RLock", "_RLock")
+    return LockdepLock(name, lock, reentrant=reentrant, per_key=per_key)
+
+
+# ------------------------------------------------------ schedule perturber
+
+@contextlib.contextmanager
+def perturb_schedule(seed: int = 0, max_delay_us: float = 200.0,
+                     switch_interval: float = 1e-5):
+    """Seeded schedule fuzzer for the ``races`` pytest lane.
+
+    Shrinks the interpreter's thread switch interval (more preemption
+    points) and arms randomized pre-acquire delays inside every
+    :class:`LockdepLock` — half the injections are bare yields, half are
+    sleeps up to ``max_delay_us``.  Deterministic per seed at the decision
+    level (the OS still owns true interleaving).  Restores both on exit.
+
+    Only instrumented locks perturb, so enable lockdep (and construct the
+    objects under test) before entering.
+    """
+    global _PERTURB
+    rng = random.Random(seed)
+    old_interval = sys.getswitchinterval()
+    sys.setswitchinterval(switch_interval * (0.5 + rng.random()))
+    prev = _PERTURB
+    _PERTURB = (rng, float(max_delay_us))
+    try:
+        yield rng
+    finally:
+        _PERTURB = prev
+        sys.setswitchinterval(old_interval)

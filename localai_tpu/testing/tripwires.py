@@ -25,6 +25,12 @@ see; these two guards catch what it can't:
   chip profile. Ragged dispatches count too, credited with the tokens they
   actually packed (generated + prefill-chunk) — only spec-as-ragged verify
   windows are exempt.
+
+The concurrency sibling lives in `localai_tpu.testing.lockdep`: the same
+env-gate pattern (`LOCALAI_LOCKDEP=1` / `record`, raw locks when unset)
+arms an acquisition-order tripwire over every lock registered through
+`lockdep_lock()` — the dynamic half of `tools/lockdep`, the way these
+guards are the dynamic half of `tools/lint`.
 """
 from __future__ import annotations
 

@@ -1,0 +1,691 @@
+"""Lock-order analysis suite (ISSUE 20): the static whole-program analyzer
+(tools/lockdep — caught + allowed case per check, including reconstructions
+of the PR 4 watchdog bug and the PR 18 spill/evict inversion), the runtime
+LOCALAI_LOCKDEP tripwire (two-thread inversion with both stacks, self-
+deadlock, record mode, hold-time trips), and the schedule-perturbing
+`races` lane re-running the three hairy lock trios — kvhost spill/evict/
+readmit, manager watchdog/supervised/load, engine preempt/cancel/decode —
+under seeded sys.setswitchinterval jitter.
+
+Static + runtime units run in tier-1; the trios carry `races` + `slow` and
+run in the CI resilience job via -m races.
+"""
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from localai_tpu.testing import lockdep as ld
+
+# ------------------------------------------------------------ static helpers
+
+
+def _analyze(tmp_path, files):
+    """Write a throwaway tree and run the static analyzer over it."""
+    from tools.lockdep.analysis import run_paths
+
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return run_paths([str(tmp_path)], root=str(tmp_path))
+
+
+def _rules(vs):
+    return sorted(v.rule for v in vs)
+
+
+# --------------------------------------------------------------- lock-order
+
+
+ORDER_BAD = """
+    from localai_tpu.testing.lockdep import lockdep_lock
+
+    A = lockdep_lock("manager.map")       # rank 20
+    B = lockdep_lock("engine.submit")     # rank 40
+
+    def fine():
+        with A:
+            with B:
+                pass
+
+    def inverted():
+        with B:
+            with A:
+                pass
+"""
+
+
+def test_lock_order_inversion_caught(tmp_path):
+    vs, _ = _analyze(tmp_path, {"pkg/mod.py": ORDER_BAD})
+    assert _rules(vs) == ["lock-order"]
+    (v,) = vs
+    assert "manager.map" in v.message and "engine.submit" in v.message
+    assert "hierarchy" in v.message
+
+
+def test_lock_order_pragma_allowed(tmp_path):
+    src = ORDER_BAD.replace(
+        "with A:\n                pass",
+        "with A:  # lockdep: allow(lock-order) — test exception\n"
+        "                pass")
+    vs, _ = _analyze(tmp_path, {"pkg/mod.py": src})
+    assert vs == [], [v.render() for v in vs]
+
+
+def test_pr18_spill_evict_reconstruction_caught(tmp_path):
+    """The PR 18 bug class: spill takes pool->digest (the sanctioned
+    order), evict takes digest->pool — an ABBA pair the rank check must
+    catch from the source alone."""
+    vs, _ = _analyze(tmp_path, {"pkg/kv.py": """
+        from localai_tpu.testing.lockdep import lockdep_lock
+
+        class Pool:
+            def __init__(self):
+                self._plock = lockdep_lock("kvhost.pool")      # rank 50
+                self._dlock = lockdep_lock("kvhost.digest")    # rank 55
+
+            def spill(self):
+                with self._plock:
+                    with self._dlock:
+                        pass
+
+            def evict(self):
+                with self._dlock:
+                    with self._plock:
+                        pass
+    """})
+    assert _rules(vs) == ["lock-order"]
+    assert "kvhost.pool" in vs[0].message
+
+
+# ------------------------------------------------------------- lock-blocking
+
+
+WATCHDOG = """
+    from localai_tpu.testing.lockdep import lockdep_lock
+
+    class Manager:
+        def __init__(self):
+            self._mu = lockdep_lock("manager.map")
+
+        def _reap(self, h):
+            h.proc.wait(timeout=10)
+
+        def watchdog(self, h):
+            with self._mu:
+                {pragma}self._reap(h)
+"""
+
+
+def test_pr4_watchdog_reconstruction_caught(tmp_path):
+    """The PR 4 bug class: the watchdog held the map lock across a reap
+    whose process wait blocks — invisible to per-function linting, caught
+    by the transitive effects summary."""
+    vs, _ = _analyze(tmp_path, {"pkg/mgr.py": WATCHDOG.format(pragma="")})
+    assert _rules(vs) == ["lock-blocking"]
+    assert "manager.map" in vs[0].message and "_reap" in vs[0].message
+
+
+def test_lock_blocking_pragma_allowed(tmp_path):
+    src = WATCHDOG.format(
+        pragma="# lockdep: allow(lock-blocking) — test exception\n"
+               "                ")
+    vs, _ = _analyze(tmp_path, {"pkg/mgr.py": src})
+    assert vs == [], [v.render() for v in vs]
+
+
+def test_direct_blocking_is_lints_not_lockdeps(tmp_path):
+    """Blocking in the SAME function as the lock is lint's
+    lock-across-blocking; lockdep only owns the transitive case — the
+    split keeps one pragma per site, not two."""
+    vs, _ = _analyze(tmp_path, {"pkg/d.py": """
+        import time
+        from localai_tpu.testing.lockdep import lockdep_lock
+
+        MU = lockdep_lock("engine.submit")
+
+        def f(proc):
+            with MU:
+                proc.wait(timeout=5)
+    """})
+    assert vs == [], [v.render() for v in vs]
+
+
+# ----------------------------------------------------------------- lock-self
+
+
+SELF_DEADLOCK = """
+    from localai_tpu.testing.lockdep import lockdep_lock
+
+    class C:
+        def __init__(self):
+            self._mu = lockdep_lock("engine.submit")
+
+        def outer(self):
+            with self._mu:
+                {pragma}self.inner()
+
+        def inner(self):
+            with self._mu:
+                pass
+"""
+
+
+def test_lock_self_caught(tmp_path):
+    vs, _ = _analyze(tmp_path,
+                     {"pkg/s.py": SELF_DEADLOCK.format(pragma="")})
+    assert _rules(vs) == ["lock-self"]
+    assert "engine.submit" in vs[0].message
+
+
+def test_lock_self_pragma_allowed(tmp_path):
+    src = SELF_DEADLOCK.format(
+        pragma="# lockdep: allow(lock-self) — test exception\n"
+               "                ")
+    vs, _ = _analyze(tmp_path, {"pkg/s.py": src})
+    assert vs == [], [v.render() for v in vs]
+
+
+# --------------------------------------------------------------- lock-cycle
+
+
+CYCLE = """
+    import threading
+
+    A = threading.Lock()
+    B = threading.Lock()
+
+    def f():
+        with A:
+            with B:
+                pass
+
+    def g():
+        with B:
+            {pragma}with A:
+                pass
+"""
+
+
+def test_lock_cycle_unranked_caught(tmp_path):
+    """Unranked locks get no rank check; the cycle detector still refuses
+    an A->B->A acquired-while-held loop."""
+    vs, _ = _analyze(tmp_path, {"pkg/c.py": CYCLE.format(pragma="")})
+    assert _rules(vs) == ["lock-cycle"]
+    assert "->" in vs[0].message
+
+
+def test_lock_cycle_pragma_allowed(tmp_path):
+    src = CYCLE.format(
+        pragma="# lockdep: allow(lock-cycle) — test exception\n"
+               "            ")
+    vs, _ = _analyze(tmp_path, {"pkg/c.py": src})
+    assert vs == [], [v.render() for v in vs]
+
+
+# ------------------------------------------------------------- unranked-lock
+
+
+def test_unranked_lock_caught_in_package_only(tmp_path):
+    files = {
+        "localai_tpu/u.py": """
+            import threading
+            RAW = threading.Lock()
+        """,
+        "tools/u.py": """
+            import threading
+            RAW = threading.Lock()    # host tooling: no rank required
+        """,
+    }
+    vs, _ = _analyze(tmp_path, files)
+    assert _rules(vs) == ["unranked-lock"]
+    assert vs[0].path == "localai_tpu/u.py"
+
+
+def test_unranked_lock_unknown_name_and_pragma(tmp_path):
+    vs, _ = _analyze(tmp_path, {"localai_tpu/u.py": """
+        from localai_tpu.testing.lockdep import lockdep_lock
+
+        N = lockdep_lock("no.such.rank")
+        # lockdep: allow(unranked-lock) — test exception
+        M = lockdep_lock("also.unranked")
+    """})
+    assert _rules(vs) == ["unranked-lock"]
+    assert "no.such.rank" in vs[0].message
+
+
+# ------------------------------------------------- pragma hygiene (static)
+
+
+def test_bad_pragma_and_stale_pragma(tmp_path):
+    vs, _ = _analyze(tmp_path, {"pkg/p.py": """
+        import threading
+
+        A = threading.Lock()
+
+        def f():
+            with A:   # lockdep: allow(not-a-check)
+                pass
+
+        def g():
+            with A:   # lockdep: allow(lock-order) — nothing to excuse
+                pass
+    """})
+    assert _rules(vs) == ["bad-pragma", "stale-pragma"]
+    bad, stale = sorted(vs, key=lambda v: v.rule)
+    assert "not-a-check" in bad.message
+    assert "allow(lock-order)" in stale.message
+
+
+def test_used_pragma_is_not_stale(tmp_path):
+    src = ORDER_BAD.replace(
+        "with A:\n                pass",
+        "with A:  # lockdep: allow(lock-order) — used\n"
+        "                pass")
+    vs, _ = _analyze(tmp_path, {"pkg/mod.py": src})
+    assert "stale-pragma" not in _rules(vs)
+
+
+# -------------------------------------------------------- unknown edges
+
+
+def test_unresolvable_call_recorded_not_dropped(tmp_path):
+    """Calls the resolver cannot pin down while a lock is held must land in
+    the unknown-edge ledger (the MCP close-under-lock bug surfaced there),
+    never vanish silently."""
+    vs, an = _analyze(tmp_path, {"pkg/u.py": """
+        from localai_tpu.testing.lockdep import lockdep_lock
+
+        MU = lockdep_lock("http.mcp")
+
+        def f(sessions):
+            with MU:
+                for s in sessions:
+                    s.close()
+    """})
+    assert vs == []
+    assert any(a == "http.mcp" and "close" in b
+               for (a, b) in an.unknown_edges)
+
+
+def test_tree_is_lockdep_clean():
+    """The acceptance gate, as a test: the shipped tree passes the
+    whole-program analyzer with reasoned pragmas only."""
+    from tools.lockdep.analysis import run_paths
+
+    vs, _ = run_paths(["localai_tpu", "tools"])
+    assert vs == [], "\n".join(v.render() for v in vs)
+
+
+# ====================================================== runtime tripwire
+
+
+def _named_lock(name):
+    """Indirection so the static analyzer does not trace these deliberately
+    inverted test locks — the runtime tripwire is the thing under test."""
+    from localai_tpu.testing.lockdep import lockdep_lock
+
+    return lockdep_lock(name, lock=threading.Lock())
+
+
+@pytest.fixture
+def lockdep_raise():
+    ld.set_lockdep_mode("raise")
+    ld.reset_lockdep()
+    yield ld
+    ld.reset_lockdep()
+    ld.set_lockdep_mode(None)
+    ld.set_hold_threshold_ms(None)
+
+
+def test_runtime_disabled_returns_raw_lock():
+    ld.set_lockdep_mode("")
+    try:
+        raw = ld.lockdep_lock("engine.submit")
+        assert type(raw) is type(threading.Lock())
+    finally:
+        ld.set_lockdep_mode(None)
+
+
+def test_runtime_two_thread_inversion_raises_with_both_stacks(lockdep_raise):
+    a = _named_lock("t.alpha")
+    b = _named_lock("t.beta")
+
+    def establish_alpha_beta():
+        with a:
+            with b:
+                pass
+
+    t = threading.Thread(target=establish_alpha_beta)
+    t.start()
+    t.join()
+    assert ("t.alpha", "t.beta") in ld.order_graph()
+    with b:
+        with pytest.raises(ld.LockdepViolation) as ei:
+            a.acquire()
+    assert ei.value.kind == "inversion"
+    # the report carries BOTH stacks: this acquire and the thread that
+    # first proved the opposite order
+    assert "--- this acquisition ---" in ei.value.report
+    assert "first observation" in ei.value.report
+    assert "establish_alpha_beta" in ei.value.report
+    assert not a.locked()      # the refused acquire took nothing
+
+
+def test_runtime_transitive_inversion(lockdep_raise):
+    a, b, c = (_named_lock(f"t.chain{i}") for i in range(3))
+    with a:
+        with b:
+            pass
+    with b:
+        with c:
+            pass
+    # a -> b -> c observed; c -> a inverts through the transitive path
+    with c:
+        with pytest.raises(ld.LockdepViolation):
+            a.acquire()
+
+
+def test_runtime_self_deadlock_raises_even_in_record():
+    ld.set_lockdep_mode("record")
+    ld.reset_lockdep()
+    try:
+        a = _named_lock("t.selfdead")
+        with a:
+            with pytest.raises(ld.LockdepViolation) as ei:
+                a.acquire()
+        assert ei.value.kind == "self-deadlock"
+    finally:
+        ld.reset_lockdep()
+        ld.set_lockdep_mode(None)
+
+
+def test_runtime_record_mode_accumulates():
+    ld.set_lockdep_mode("record")
+    ld.reset_lockdep()
+    try:
+        a = _named_lock("t.rec.a")
+        b = _named_lock("t.rec.b")
+        with a:
+            with b:
+                pass
+        with b:
+            with a:       # inversion: recorded, not raised
+                pass
+        vs = ld.violations()
+        assert len(vs) == 1 and vs[0]["kind"] == "inversion"
+        assert "t.rec.a" in vs[0]["title"]
+    finally:
+        ld.reset_lockdep()
+        ld.set_lockdep_mode(None)
+
+
+def test_runtime_same_class_instances_never_nest(lockdep_raise):
+    k1 = _named_lock("t.perkey")
+    k2 = _named_lock("t.perkey")
+    with k1:
+        with pytest.raises(ld.LockdepViolation) as ei:
+            k2.acquire()
+    assert "same class" in ei.value.report
+
+
+def test_runtime_hold_trip_releases_lock_first(lockdep_raise):
+    ld.set_hold_threshold_ms(5)
+    a = _named_lock("t.hold")
+    # lint: allow(acquire-release-finally) — the bare release IS the thing
+    # under test: the trip must fire from it without leaking the lock
+    a.acquire()
+    time.sleep(0.03)
+    with pytest.raises(ld.LockdepViolation) as ei:
+        a.release()
+    assert ei.value.kind == "hold"
+    assert "acquired at" in ei.value.report
+    # the trip must never leave the real lock held
+    assert not a.locked()
+    with a:
+        pass
+
+
+def test_perturb_schedule_restores_switch_interval():
+    before = __import__("sys").getswitchinterval()
+    with ld.perturb_schedule(seed=7) as rng:
+        assert __import__("sys").getswitchinterval() != before
+        first = rng.random()
+    assert __import__("sys").getswitchinterval() == before
+    with ld.perturb_schedule(seed=7) as rng:
+        assert rng.random() == first      # seeded: same decision stream
+
+
+# ================================================= schedule-perturbed trios
+
+
+def _run_trio(fns, timeout=60.0):
+    """Run the trio's callables on threads; returns exceptions raised in
+    them. A thread still alive after `timeout` means a deadlock — fail
+    loudly rather than hang the lane."""
+    errs = []
+
+    def wrap(fn):
+        def run():
+            try:
+                fn()
+            except Exception as e:    # harness boundary: surface, don't die
+                errs.append(e)
+        return run
+
+    ts = [threading.Thread(target=wrap(fn), daemon=True) for fn in fns]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout)
+    assert not any(t.is_alive() for t in ts), "race trio deadlocked"
+    return errs
+
+
+def _blk(seed: int = 0):
+    from localai_tpu.engine.kvhost import HostKVBlock
+
+    r = np.random.default_rng(seed)
+    return HostKVBlock(
+        kq=r.integers(-128, 127, (1, 1, 4, 2)).astype(np.int8),
+        ks=r.random((1, 1, 1, 4)).astype(np.float32),
+        vq=r.integers(-128, 127, (1, 1, 4, 2)).astype(np.int8),
+        vs=r.random((1, 1, 1, 4)).astype(np.float32),
+    )
+
+
+def _h(i: int) -> bytes:
+    return i.to_bytes(16, "big")
+
+
+@pytest.mark.races
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(24))
+def test_races_kvhost_spill_evict_readmit(seed, lockdep_raise):
+    """PR 18's hairy trio: concurrent spill (put), evict-pressure
+    (pin/unpin under a tight budget), and re-admission reads — every lock
+    acquisition order-checked and schedule-jittered per seed."""
+    from localai_tpu.engine.kvhost import HostKVPool
+
+    pool = HostKVPool(budget_bytes=6 * _blk().nbytes)
+    blocks = {i: _blk(i) for i in range(32)}
+    with ld.perturb_schedule(seed):
+        def spill():
+            for i in range(32):
+                pool.put(_h(i), blocks[i], group=_h(i % 4))
+
+        def readmit():
+            for i in range(32):
+                pool.get(_h(i))
+                pool.contains(_h(i))
+
+        def evict():
+            for i in range(32):
+                if pool.pin(_h(i)):
+                    pool.unpin(_h(i))
+                pool.stats()
+
+        errs = _run_trio([spill, readmit, evict])
+    assert errs == [], errs
+    assert ld.violations() == []
+
+
+@pytest.mark.races
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(10))
+def test_races_manager_watchdog_supervised_load(seed, monkeypatch,
+                                               lockdep_raise):
+    """PR 4's hairy trio: the busy-watchdog reaping while supervised
+    requests mark handles busy and loads respawn the same models — with
+    fake instant backends so only the locking is exercised."""
+    from localai_tpu.config import AppConfig, ModelConfig
+    from localai_tpu.core.manager import BackendHandle, ModelManager
+
+    class _FakeProc:
+        def __init__(self):
+            self.rc = None
+            self.stdout = None
+            self.pid = 0
+
+        def poll(self):
+            return self.rc
+
+        def wait(self, timeout=None):
+            self.rc = 0
+            return 0
+
+        def terminate(self):
+            self.rc = 0
+
+        def kill(self):
+            self.rc = 0
+
+        def send_signal(self, sig):
+            self.rc = 0
+
+    class _FakeClient:
+        def health(self, timeout=None):
+            return True
+
+        def close(self):
+            pass
+
+    def fake_spawn_once(self, cfg):
+        return BackendHandle(name=cfg.name, config=cfg, proc=_FakeProc(),
+                             client=_FakeClient(), port=0)
+
+    monkeypatch.setattr(ModelManager, "_spawn_once", fake_spawn_once)
+    monkeypatch.setattr(ModelManager, "_load_rpc", lambda self, h: None)
+    app = AppConfig(watchdog_busy_timeout=0.02, retry_budget=0)
+    mgr = ModelManager(app)
+    cfg_a = ModelConfig(name="ra")
+    cfg_b = ModelConfig(name="rb")
+    with ld.perturb_schedule(seed):
+        mgr.start_watchdog(interval=0.01)
+
+        def loads():
+            for _ in range(12):
+                mgr.load(cfg_a)
+                mgr.load(cfg_b)
+                mgr.stop_model("rb")
+
+        def supervised():
+            for _ in range(12):
+                mgr.supervised(cfg_a, lambda h: h.name)
+
+        def busy_churn():
+            # park handles busy long enough for the watchdog to reap them
+            for _ in range(12):
+                h = mgr.get("ra")
+                if h is not None:
+                    # lint: allow(acquire-release-finally) — unguarded on
+                    # purpose: the watchdog may reap the handle mid-hold,
+                    # exactly the interleaving the trio exists to exercise
+                    h.mark_busy()
+                    time.sleep(0.03)
+                    h.mark_idle()
+
+        errs = _run_trio([loads, supervised, busy_churn])
+    mgr.stop_all()
+    assert errs == [], errs
+    assert ld.violations() == []
+
+
+TINY = dict(vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+            max_position=512, dtype="float32")
+
+
+@pytest.mark.races
+@pytest.mark.slow
+def test_races_engine_preempt_cancel_decode():
+    """ISSUE 19's hairy trio: a decode loop stepping, a submitter feeding
+    it, a canceller evicting mid-flight — then a preempt spill-drain at a
+    seed-dependent boundary. One engine, many seeds (construction is the
+    expensive part; the races are per-seed)."""
+    import jax
+
+    from localai_tpu.engine.engine import Engine, EngineConfig, GenRequest
+    from localai_tpu.models.llama import LlamaConfig, init_params
+    from localai_tpu.ops.sampling import SamplingParams
+
+    ld.set_lockdep_mode("raise")
+    ld.reset_lockdep()
+    try:
+        cfg = LlamaConfig(**TINY)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        eng = Engine(cfg, params, None, EngineConfig(
+            max_slots=2, max_context=512, prefill_buckets=(64,),
+            prefill_chunk=64, kv_pages=6, prompt_cache=True,
+            decode_loop=8, decode_block=4, cache_type="int8",
+            kv_host_bytes=1 << 20))
+        prompt = [3, 5, 7, 11, 13]
+        for seed in range(4):
+            with ld.perturb_schedule(seed):
+                stop = threading.Event()
+                rids = []
+
+                def decode():
+                    while not stop.is_set():
+                        if not eng.step():
+                            time.sleep(0.001)
+
+                def submit():
+                    for i in range(4):
+                        rid, _out = eng.submit(GenRequest(
+                            prompt_ids=list(prompt), max_tokens=32,
+                            params=SamplingParams(temperature=0.0),
+                            ignore_eos=True))
+                        rids.append(rid)
+                        time.sleep(0.002)
+
+                def cancel():
+                    for _ in range(8):
+                        if rids:
+                            eng.cancel(rids[len(rids) // 2])
+                        time.sleep(0.003)
+
+                t_dec = threading.Thread(target=decode, daemon=True)
+                t_dec.start()
+                errs = _run_trio([submit, cancel])
+                time.sleep(0.02)
+                stop.set()
+                t_dec.join(60.0)
+                assert not t_dec.is_alive(), "decode thread wedged"
+                assert errs == [], errs
+                eng.preempt()          # spill-drain at this seed's boundary
+        # the engine must stay serviceable after every preempt
+        rid, out = eng.submit(GenRequest(
+            prompt_ids=list(prompt), max_tokens=4,
+            params=SamplingParams(temperature=0.0), ignore_eos=True))
+        for _ in range(200):
+            eng.step()
+            if not out.empty() and out.queue[-1].finished:
+                break
+        assert ld.violations() == []
+    finally:
+        ld.reset_lockdep()
+        ld.set_lockdep_mode(None)

@@ -137,12 +137,19 @@ class FileContext:
 _PRAGMA = re.compile(r"#\s*lint:\s*allow\(([^)]*)\)")
 
 
-def collect_pragmas(source: str) -> tuple[dict[int, set[str]], list[tuple[int, str]]]:
+def collect_pragmas(source: str, tag: str = "lint") -> tuple[
+        dict[int, set[str]], list[tuple[int, str]]]:
     """Map line → rule names allowed there. A pragma comment applies to its
     own line; when the comment stands alone on a line it also covers the next
     line (for statements too long to carry a trailing comment).
 
+    `tag` is the pragma namespace — "lint" for `# lint: allow(...)`,
+    "lockdep" for the lock-order analyzer's `# lockdep: allow(...)` (same
+    statement-aware semantics, separate allowlists).
+
     Returns (allowed-by-line, [(line, raw-names)] for pragma validation)."""
+    pragma_re = _PRAGMA if tag == "lint" else re.compile(
+        r"#\s*" + re.escape(tag) + r":\s*allow\(([^)]*)\)")
     allowed: dict[int, set[str]] = {}
     raw: list[tuple[int, str]] = []
     try:
@@ -153,7 +160,7 @@ def collect_pragmas(source: str) -> tuple[dict[int, set[str]], list[tuple[int, s
     for tok in toks:
         if tok.type != tokenize.COMMENT:
             continue
-        m = _PRAGMA.search(tok.string)
+        m = pragma_re.search(tok.string)
         if not m:
             continue
         names = {n.strip() for n in m.group(1).split(",") if n.strip()}
@@ -214,18 +221,46 @@ def run_source(source: str, path: str, config: Config | None = None):
         if isinstance(node, ast.stmt) and getattr(node, "end_lineno", None):
             spans.append((node.lineno, node.end_lineno))
 
+    # which pragma line(s) granted each (line, rule) — so stale-pragma can
+    # tell exercised pragmas from rotting ones.  contributors mirrors
+    # `allowed`, attributing each grant back to its source comment line.
+    contributors: dict[int, dict[str, set[int]]] = {}
+    src_lines = source.splitlines()
+    for pln, names_raw in raw_pragmas:
+        names = {n.strip() for n in names_raw.split(",") if n.strip()}
+        covers = {pln}
+        text = src_lines[pln - 1] if pln <= len(src_lines) else ""
+        if text.lstrip().startswith("#"):    # standalone comment pragma
+            nxt = pln
+            while nxt < len(src_lines):
+                stripped = src_lines[nxt].strip()
+                if stripped and not stripped.startswith("#"):
+                    covers.add(nxt + 1)
+                    break
+                nxt += 1
+        for ln in covers:
+            for name in names:
+                contributors.setdefault(ln, {}).setdefault(
+                    name, set()).add(pln)
+    used_pragmas: set[tuple[int, str]] = set()
+
     def suppressed(rule_name: str, line: int) -> bool:
-        if rule_name in allowed.get(line, ()):
-            return True
+        lines = {line}
         best = None
         for s, e in spans:
             if s <= line <= e and (best is None
                                    or (e - s) < (best[1] - best[0])):
                 best = (s, e)
-        if best is None:
-            return False
-        return any(rule_name in allowed.get(ln, ())
-                   for ln in range(best[0], best[1] + 1))
+        if best is not None:
+            lines.update(range(best[0], best[1] + 1))
+        hit = False
+        for ln in lines:
+            if rule_name in allowed.get(ln, ()):
+                used_pragmas.update(
+                    (p, rule_name)
+                    for p in contributors.get(ln, {}).get(rule_name, ()))
+                hit = True
+        return hit
 
     seen: set[tuple] = set()
     for rule in get_rules(config):
@@ -237,6 +272,19 @@ def run_source(source: str, path: str, config: Config | None = None):
                 continue   # nested defs are walked from both scopes
             seen.add(key)
             out.append(v)
+    # stale-pragma: a pragma naming a known rule that suppressed nothing.
+    # Only meaningful on a full run — under --select most rules never ran,
+    # so their pragmas would all look stale.
+    if not config.select:
+        for pln, names_raw in raw_pragmas:
+            for name in (n.strip() for n in names_raw.split(",")):
+                if (name in rule_names and (pln, name) not in used_pragmas
+                        and not suppressed("stale-pragma", pln)):
+                    out.append(Violation(
+                        path, pln, "stale-pragma",
+                        f"pragma allow({name}) suppresses nothing — the "
+                        f"violation it excused is gone; remove the pragma "
+                        f"so the allowlist stays honest"))
     out.sort(key=lambda v: (v.path, v.line, v.rule))
     return out
 

@@ -4,7 +4,15 @@
 held the model-map lock across Popen.wait(timeout=10), freezing every
 load()/get() for the duration of a reap. `acquire-release-finally` is the
 mark_busy audit from the same PR turned permanent: an acquire whose release
-isn't exception-protected leaks the resource on the first RpcError."""
+isn't exception-protected leaks the resource on the first RpcError.
+
+Scope contract with `tools/lockdep` (which imports `_blocking_reason` and
+`_LOCKLIKE` from here): this rule owns blocking calls in the SAME function
+body as the lock; the whole-program analyzer's `lock-blocking` check owns
+the transitive case — blocking reached through callees — plus lock-order
+inversions against the rank hierarchy. One bug class, one pragma namespace
+each: direct sites carry `# lint: allow(lock-across-blocking)`, transitive
+sites `# lockdep: allow(lock-blocking)`."""
 from __future__ import annotations
 
 import ast

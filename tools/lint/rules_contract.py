@@ -162,4 +162,21 @@ class PytestMarkerRegistered:
                 f"see it")
 
 
-RULES = [ShardingSpecSource(), Pb2DirectImport(), PytestMarkerRegistered()]
+class StalePragma:
+    """Declaration only — the detection lives in core.run_source, which is
+    the one place that knows whether a pragma actually suppressed anything
+    this run.  The class exists so the rule is listed, selectable, and a
+    known name to bad-pragma."""
+
+    name = "stale-pragma"
+    family = "contract"
+    description = ("`# lint: allow(rule)` pragma that no longer suppresses "
+                   "any diagnostic — a stale allowlist entry hides the day "
+                   "the violation comes back")
+
+    def check(self, ctx):
+        return ()
+
+
+RULES = [ShardingSpecSource(), Pb2DirectImport(), PytestMarkerRegistered(),
+         StalePragma()]

@@ -1,0 +1,11 @@
+"""localai-lockdep: whole-program lock-order analysis.
+
+Stdlib-only (ast + tokenize) like tools/lint — the CI gate runs before
+any dependency install.  See tools/lockdep/analysis.py for the checks and
+tools/lockdep/hierarchy.py for the declared lock hierarchy; the runtime
+half (LOCALAI_LOCKDEP tripwire + schedule perturber) lives in
+localai_tpu/testing/lockdep.py.
+
+    python -m tools.lockdep localai_tpu tools tests
+"""
+from tools.lockdep.analysis import CHECKS, Analyzer, run_paths  # noqa: F401

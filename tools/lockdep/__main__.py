@@ -1,0 +1,74 @@
+"""CLI: `python -m tools.lockdep [paths...]` — whole-program lock-order
+analysis.  Emits `file:line check message` per violation and exits nonzero
+when any survive their `# lockdep: allow(...)` pragmas.  Stdlib-only: the
+CI gate runs it before any dependency install."""
+from __future__ import annotations
+
+import argparse
+import sys
+
+from tools.lockdep.analysis import CHECKS, run_paths
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.lockdep",
+        description="localai-tpu whole-program lock-order analysis")
+    ap.add_argument("paths", nargs="*",
+                    default=["localai_tpu", "tools", "tests"],
+                    help="files/directories to analyze (default: the tree)")
+    ap.add_argument("--list-checks", action="store_true",
+                    help="print the check catalog and exit")
+    ap.add_argument("--graph", action="store_true",
+                    help="dump the acquired-while-held edge graph "
+                         "(including unresolved-call edges) and exit 0")
+    ap.add_argument("--locks", action="store_true",
+                    help="dump the discovered lock inventory and exit 0")
+    ap.add_argument("--statistics", action="store_true",
+                    help="append per-check violation counts and the "
+                         "unresolved-call tally")
+    args = ap.parse_args(argv)
+
+    if args.list_checks:
+        for name, desc in sorted(CHECKS.items()):
+            print(f"{name:16s} {desc}")
+        return 0
+
+    violations, an = run_paths(args.paths)
+
+    if args.locks:
+        for sid, ld in sorted(an.locks.items()):
+            kind = "per-key " if ld.per_key else ""
+            rank = f"rank {ld.rank}" if ld.rank is not None else "UNRANKED"
+            print(f"{ld.label:28s} {rank:>10s}  {kind}{sid}  "
+                  f"({ld.path}:{ld.line})")
+        return 0
+    if args.graph:
+        for (a, b), sites in sorted(an.edges.items()):
+            path, line, via = sites[0]
+            extra = f" (+{len(sites) - 1} more)" if len(sites) > 1 else ""
+            print(f"{a} -> {b}  [{path}:{line}{via}]{extra}")
+        for (a, b), n in sorted(an.unknown_edges.items()):
+            print(f"{a} -> {b}  [unresolved x{n}]")
+        return 0
+
+    for v in violations:
+        print(v.render())
+    if args.statistics:
+        counts: dict[str, int] = {}
+        for v in violations:
+            counts[v.rule] = counts.get(v.rule, 0) + 1
+        print("--")
+        for rule, n in sorted(counts.items(), key=lambda kv: -kv[1]):
+            print(f"{n:5d}  {rule}")
+        print(f"--  {len(an.locks)} locks, {len(an.edges)} edges, "
+              f"{sum(an.unknown_calls.values())} unresolved calls "
+              f"({len(an.unknown_edges)} under a lock)")
+    if violations:
+        print(f"-- {len(violations)} violation(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,988 @@
+"""Whole-program lock-order analysis (the static half of localai-lockdep).
+
+Stdlib-only AST, built on tools/lint's helpers.  Three passes:
+
+1. **Inventory** — parse every file, collect lock objects: module-level
+   locks (``_TRACER_LOCK = threading.Lock()``), attribute locks
+   (``self._lock = lockdep_lock("kvhost.pool")``), dataclass-field locks,
+   and per-key lock dicts (``self._model_locks[name] = ...``).  Locks
+   created through ``lockdep_lock("name")`` carry their hierarchy name in
+   the source; the rest resolve through ``hierarchy.STATIC_IDS``.  Also
+   collect the symbol tables the call resolver needs: functions, classes,
+   imports, ``self.attr`` types (from ctor assignments and annotations)
+   and return-annotation types.
+
+2. **Summaries** — per function, a memoized interprocedural effects
+   summary: every lock the function (or anything it calls, transitively)
+   acquires, every blocking call it can reach, and every call it could
+   not resolve.  Calls resolve through direct names, imports, ``self.``
+   methods, typed attributes/locals, constructors, and — when the
+   receiver type is unknown — a bounded class-hierarchy fan-out over the
+   in-package methods of that name (≤ MAX_CHA implementations; more, or
+   none, records an ``unknown`` call instead of silently dropping it).
+
+3. **Checks** — walk each function with a held-lock stack; every
+   acquisition while holding produces an edge ``outer -> inner`` checked
+   against the declared hierarchy (tools/lockdep/hierarchy.py):
+
+   - ``lock-order``     edge violating the declared ranks (rank(outer)
+                        must be strictly lower)
+   - ``lock-cycle``     cycle among edges the rank check could not cover
+                        (unranked locks)
+   - ``lock-self``      same lock (or same per-key lock CLASS) acquired
+                        while held — self-deadlock / ABBA hazard
+   - ``lock-blocking``  blocking call reachable **through callees** while
+                        a lock is held (depth ≥ 1 — the same-function
+                        case stays lint's ``lock-across-blocking``)
+   - ``unranked-lock``  a lock in localai_tpu/ the hierarchy doesn't rank
+   - ``bad-pragma``     ``# lockdep: allow(...)`` naming an unknown check
+   - ``stale-pragma``   a lockdep pragma that no longer suppresses
+                        anything
+
+Suppression: ``# lockdep: allow(check) — reason`` with the same
+statement-aware semantics as lint pragmas (same line, any line of the
+statement, or alone on the line above).
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from tools.lint.astutil import dotted, last_segment, walk_skip_defs
+from tools.lint.core import (
+    EXCLUDED_FILES, Violation, collect_pragmas, find_root, iter_py_files,
+)
+from tools.lint.rules_concurrency import _LOCKLIKE, _blocking_reason
+
+from tools.lockdep import hierarchy
+
+CHECKS = {
+    "lock-order": "acquisition order contradicts the declared hierarchy",
+    "lock-cycle": "cycle in the acquired-while-held graph",
+    "lock-self": "same lock (or per-key lock class) acquired while held",
+    "lock-blocking": "blocking call reachable through callees under a lock",
+    "unranked-lock": "lock not ranked in tools/lockdep/hierarchy.py",
+    "bad-pragma": "lockdep pragma naming an unknown check",
+    "stale-pragma": "lockdep pragma that suppresses nothing",
+}
+
+# unresolved method calls with these names are container/string/file plumbing
+# — never lock-relevant, never blocking in-process
+SAFE_METHODS = {
+    "append", "appendleft", "extend", "pop", "popleft", "popitem", "get",
+    "setdefault", "update", "clear", "keys", "values", "items", "add",
+    "discard", "remove", "insert", "index", "count", "sort", "reverse",
+    "copy", "split", "rsplit", "strip", "lstrip", "rstrip", "startswith",
+    "endswith", "encode", "decode", "format", "lower", "upper", "replace",
+    "lstat", "exists", "read", "write", "readline", "flush", "seek",
+    "tell", "fileno", "poll", "most_common", "total", "elements",
+    "as_integer_ratio", "hex", "bit_length", "item", "tolist", "tobytes",
+    "astype", "reshape", "sum", "mean", "max", "min", "all", "any",
+    "set", "is_set", "isoformat", "timestamp", "groups", "group", "match",
+    "search", "findall", "sub", "fullmatch", "title", "capitalize",
+    "zfill", "partition", "rpartition", "casefold", "difference", "union",
+    "intersection", "issubset", "issuperset", "symmetric_difference",
+    "getsockname", "ljust", "rjust", "center", "move_to_end", "fromkeys",
+    "data_as",
+}
+# names in annotations that are containers/typing plumbing, not classes
+_ANN_PLUMBING = {
+    "list", "dict", "set", "tuple", "frozenset", "type", "str", "int",
+    "float", "bool", "bytes", "bytearray", "object", "None", "Optional",
+    "Union", "Any", "Iterable", "Iterator", "Sequence", "Mapping",
+    "MutableMapping", "Callable", "Generator", "deque", "defaultdict",
+    "OrderedDict", "Counter", "List", "Dict", "Set", "Tuple", "typing",
+}
+# call roots that never re-enter package code (stdlib / third-party)
+IGNORED_ROOTS = {
+    "os", "sys", "io", "re", "json", "math", "time", "ast", "abc",
+    "logging", "collections", "itertools", "functools", "contextlib",
+    "dataclasses", "threading", "queue", "socket", "subprocess", "select",
+    "shlex", "shutil", "tempfile", "pathlib", "hashlib", "hmac", "base64",
+    "struct", "uuid", "random", "string", "textwrap", "traceback",
+    "types", "typing", "warnings", "weakref", "heapq", "bisect", "copy",
+    "pickle", "signal", "inspect", "tokenize", "unicodedata", "platform",
+    "np", "numpy", "jax", "jnp", "grpc", "aiohttp", "web", "asyncio",
+    "pytest", "ctypes", "tomllib", "yaml", "secrets", "urllib", "http",
+    "email", "errno", "gc", "glob", "gzip", "zlib", "tarfile", "zipfile",
+    "enum", "operator", "array", "statistics", "difflib", "fnmatch",
+}
+BUILTINS = {
+    "print", "len", "range", "enumerate", "zip", "map", "filter", "sorted",
+    "reversed", "min", "max", "sum", "abs", "round", "int", "float", "str",
+    "bytes", "bytearray", "bool", "list", "tuple", "dict", "set",
+    "frozenset", "isinstance", "issubclass", "getattr", "setattr",
+    "hasattr", "delattr", "repr", "hash", "id", "iter", "next", "open",
+    "type", "vars", "dir", "callable", "super", "format", "ord", "chr",
+    "divmod", "pow", "any", "all", "memoryview", "slice", "object",
+    "Exception", "ValueError", "RuntimeError", "KeyError", "TypeError",
+    "AssertionError", "StopIteration", "NotImplementedError", "OSError",
+    "staticmethod", "classmethod", "property", "globals", "locals",
+}
+MAX_CHA = 4            # fan-out cap for untyped method calls
+MAX_BLOCK_DEPTH = 8    # call-path hops shown in a lock-blocking message
+
+_LOCK_CTORS = {"threading.Lock", "threading.RLock", "Lock", "RLock"}
+_LOCKDEP_CTORS = {"lockdep_lock", "lockdep.lockdep_lock"}
+
+
+class LockDef:
+    """One discovered lock object (or per-key lock class)."""
+
+    __slots__ = ("static_id", "name", "per_key", "reentrant", "path",
+                 "line")
+
+    def __init__(self, static_id, name, per_key, reentrant, path, line):
+        self.static_id = static_id   # module.Class.attr / module.GLOBAL
+        self.name = name             # hierarchy name ("" = unranked)
+        self.per_key = per_key
+        self.reentrant = reentrant
+        self.path = path
+        self.line = line
+
+    @property
+    def label(self) -> str:
+        return self.name or self.static_id
+
+    @property
+    def rank(self):
+        return hierarchy.RANKS.get(self.name) if self.name else None
+
+
+def _lock_ctor_info(value: ast.AST):
+    """(is_lock, hierarchy_name, per_key, reentrant) for an assignment
+    RHS.  Handles threading.Lock()/RLock(), lockdep_lock("name", ...),
+    field(default_factory=threading.Lock) and
+    field(default_factory=lambda: lockdep_lock("name"))."""
+    if not isinstance(value, ast.Call):
+        return (False, "", False, False)
+    fname = dotted(value.func) or ""
+    if fname in _LOCK_CTORS:
+        return (True, "", False, fname.endswith("RLock"))
+    if fname in _LOCKDEP_CTORS:
+        name = ""
+        if value.args and isinstance(value.args[0], ast.Constant) \
+                and isinstance(value.args[0].value, str):
+            name = value.args[0].value
+        per_key = any(kw.arg == "per_key"
+                      and isinstance(kw.value, ast.Constant)
+                      and kw.value.value for kw in value.keywords)
+        return (True, name, per_key, False)
+    if fname in ("field", "dataclasses.field"):
+        for kw in value.keywords:
+            if kw.arg != "default_factory":
+                continue
+            v = kw.value
+            if isinstance(v, ast.Lambda):
+                return _lock_ctor_info(v.body)
+            vd = dotted(v) or ""
+            if vd in _LOCK_CTORS:
+                return (True, "", False, vd.endswith("RLock"))
+    return (False, "", False, False)
+
+
+def _annotation_classes(node: ast.AST) -> list[str]:
+    """Bare class names mentioned in an annotation (for `x: Foo`,
+    `-> list[Foo]`, `dict[str, list[Foo]]`, `"Foo"` strings)."""
+    out = []
+    if node is None:
+        return out
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        try:
+            node = ast.parse(node.value, mode="eval").body
+        except SyntaxError:
+            return out
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            if sub.id not in _ANN_PLUMBING:
+                out.append(sub.id)
+        elif isinstance(sub, ast.Attribute):
+            seg = last_segment(sub)
+            if seg and seg not in _ANN_PLUMBING:
+                out.append(seg)
+    return out
+
+
+class ModuleInfo:
+    def __init__(self, mod: str, path: str, tree: ast.Module):
+        self.mod = mod
+        self.path = path
+        self.tree = tree
+        self.functions: dict[str, ast.AST] = {}     # qual -> def node
+        self.classes: dict[str, ast.ClassDef] = {}  # qual -> class node
+        self.imports: dict[str, str] = {}           # local name -> dotted
+        # (class qual, attr) -> class qual of the value
+        self.attr_types: dict[tuple[str, str], str] = {}
+
+
+class Analyzer:
+    def __init__(self, root: str):
+        self.root = root
+        self.modules: dict[str, ModuleInfo] = {}
+        self.files: dict[str, str] = {}             # rel path -> source
+        self.locks: dict[str, LockDef] = {}         # static_id -> LockDef
+        self.func_index: dict[str, tuple[ModuleInfo, ast.AST]] = {}
+        self.class_index: dict[str, tuple[ModuleInfo, ast.ClassDef]] = {}
+        # bare class name -> [qual] (import-free fallback + CHA)
+        self.class_by_name: dict[str, list[str]] = {}
+        # method name -> [(class qual, func qual)] for CHA fan-out
+        self.methods_by_name: dict[str, list[tuple[str, str]]] = {}
+        # func qual -> lock static_id it returns (lock getters)
+        self.lock_getters: dict[str, str] = {}
+        # func qual -> class qual it returns (annotation-driven)
+        self.returns_class: dict[str, str] = {}
+        self.summaries: dict[str, dict] = {}
+        self._in_progress: set[str] = set()
+        self.violations: list[Violation] = []
+        # (outer label, inner label) -> [(path, line, via)]
+        self.edges: dict[tuple[str, str], list[tuple[str, int, str]]] = {}
+        self.unknown_calls: dict[str, int] = {}
+        # labels of held locks at unresolved-call sites
+        self.unknown_edges: dict[tuple[str, str], int] = {}
+
+    # ---------------------------------------------------------- pass 1
+
+    def load(self, targets: list[str]) -> None:
+        for target in targets:
+            for fp in iter_py_files(target):
+                rel = os.path.relpath(os.path.abspath(fp),
+                                      self.root).replace(os.sep, "/")
+                if rel in EXCLUDED_FILES or rel in self.files:
+                    continue
+                try:
+                    with open(fp, encoding="utf-8") as f:
+                        src = f.read()
+                except (OSError, UnicodeDecodeError) as e:
+                    self.violations.append(Violation(rel, 1, "unreadable",
+                                                     str(e)))
+                    continue
+                self.files[rel] = src
+                try:
+                    tree = ast.parse(src)
+                except SyntaxError as e:
+                    self.violations.append(Violation(
+                        rel, e.lineno or 1, "syntax-error", str(e.msg)))
+                    continue
+                self._index_module(rel, src, tree)
+
+    @staticmethod
+    def _module_name(rel: str) -> str:
+        mod = rel[:-3] if rel.endswith(".py") else rel
+        mod = mod.replace("/", ".")
+        if mod.endswith(".__init__"):
+            mod = mod[: -len(".__init__")]
+        return mod
+
+    def _index_module(self, rel: str, src: str, tree: ast.Module) -> None:
+        mod = self._module_name(rel)
+        mi = ModuleInfo(mod, rel, tree)
+        self.modules[mod] = mi
+
+        for node in tree.body:
+            self._index_stmt(mi, node, scope=mod, cls=None)
+        # imports anywhere (function-local imports matter: http.py pulls
+        # sessions_from_config inside the method that uses it)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    mi.imports[a.asname or a.name.split(".")[0]] = \
+                        a.name if a.asname else a.name.split(".")[0]
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.level == 0:
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    mi.imports[a.asname or a.name] = \
+                        f"{node.module}.{a.name}"
+
+    def _index_stmt(self, mi, node, scope, cls) -> None:
+        if isinstance(node, ast.ClassDef):
+            qual = f"{scope}.{node.name}"
+            mi.classes[qual] = node
+            self.class_index[qual] = (mi, node)
+            self.class_by_name.setdefault(node.name, []).append(qual)
+            for sub in node.body:
+                self._index_stmt(mi, sub, scope=qual, cls=qual)
+            # dataclass-field locks declared in the class body
+            for sub in node.body:
+                if isinstance(sub, ast.AnnAssign) and sub.value is not None \
+                        and isinstance(sub.target, ast.Name):
+                    self._maybe_lock(mi, f"{qual}.{sub.target.id}",
+                                     sub.value, sub.lineno)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            qual = f"{scope}.{node.name}"
+            mi.functions[qual] = node
+            self.func_index[qual] = (mi, node)
+            if cls is not None:
+                self.methods_by_name.setdefault(node.name, []).append(
+                    (cls, qual))
+                self._scan_method(mi, cls, qual, node)
+            rets = _annotation_classes(node.returns)
+            if len(rets) == 1:
+                self.returns_class[qual] = rets[0]   # resolved lazily
+        elif isinstance(node, ast.Assign) and cls is None:
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self._maybe_lock(mi, f"{scope}.{t.id}", node.value,
+                                     node.lineno)
+        elif isinstance(node, ast.AnnAssign) and cls is None \
+                and node.value is not None and isinstance(node.target,
+                                                          ast.Name):
+            self._maybe_lock(mi, f"{scope}.{node.target.id}", node.value,
+                             node.lineno)
+
+    def _maybe_lock(self, mi, static_id, value, lineno,
+                    per_key_override=False) -> None:
+        is_lock, name, per_key, reentrant = _lock_ctor_info(value)
+        if not is_lock:
+            return
+        if not name:
+            name = hierarchy.STATIC_IDS.get(static_id, "")
+        self.locks[static_id] = LockDef(
+            static_id, name, per_key or per_key_override
+            or name in hierarchy.PER_KEY, reentrant, mi.path, lineno)
+
+    def _scan_method(self, mi, cls, qual, fn) -> None:
+        """Attribute locks, per-key lock dicts, attr types and lock
+        getters declared inside a method body."""
+        for node in walk_skip_defs(fn):
+            targets = []
+            value = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            if value is None:
+                continue
+            for t in targets:
+                # self.X = <lock ctor> / self.X: T = ...
+                if isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id == "self":
+                    self._maybe_lock(mi, f"{cls}.{t.attr}", value,
+                                     node.lineno)
+                    # self.X = ClassName(...): attribute type
+                    if isinstance(value, ast.Call):
+                        cname = dotted(value.func)
+                        if cname and cname[0].isupper() or (
+                                cname and "." in cname
+                                and cname.rsplit(".", 1)[1][:1].isupper()):
+                            mi.attr_types[(cls, t.attr)] = cname
+                    if isinstance(node, ast.AnnAssign):
+                        anns = _annotation_classes(node.annotation)
+                        if len(anns) == 1:
+                            mi.attr_types.setdefault((cls, t.attr),
+                                                     anns[0])
+                # self.D[k] = <lock ctor>: per-key lock dict
+                elif isinstance(t, ast.Subscript) and \
+                        isinstance(t.value, ast.Attribute) and \
+                        isinstance(t.value.value, ast.Name) and \
+                        t.value.value.id == "self":
+                    self._maybe_lock(mi, f"{cls}.{t.value.attr}", value,
+                                     node.lineno, per_key_override=True)
+        # lock getter: every return resolves to one discovered lock
+        ret_ids = set()
+        plain_return = False
+        for node in walk_skip_defs(fn):
+            if not isinstance(node, ast.Return):
+                continue
+            rid = self._lock_id_of_expr(mi, cls, fn, node.value)
+            if rid is not None:
+                ret_ids.add(rid)
+            else:
+                plain_return = True
+        if len(ret_ids) == 1 and not plain_return:
+            self.lock_getters[qual] = next(iter(ret_ids))
+
+    def _lock_id_of_expr(self, mi, cls, fn, expr):
+        """static_id if `expr` denotes a discovered lock (self.X,
+        MODULE_LOCK, self.D[...], self.D.get(...), or a local assigned
+        from one of those)."""
+        if expr is None:
+            return None
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and expr.value.id == "self" \
+                and cls is not None:
+            sid = f"{cls}.{expr.attr}"
+            return sid if sid in self.locks else None
+        if isinstance(expr, ast.Name):
+            sid = f"{mi.mod}.{expr.id}"
+            if sid in self.locks:
+                return sid
+            # local variable assigned from a lock expression
+            for node in walk_skip_defs(fn):
+                val = None
+                if isinstance(node, ast.Assign):
+                    tgt_names = []
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            tgt_names.append(t.id)
+                        elif isinstance(t, ast.Subscript):
+                            # chained `lk = self.D[k] = ctor`
+                            continue
+                    if expr.id in tgt_names:
+                        val = node.value
+                if val is not None and not isinstance(val, ast.Name):
+                    rid = self._lock_id_of_expr(mi, cls, fn, val)
+                    if rid is not None:
+                        return rid
+                    is_lock, name, per_key, reent = _lock_ctor_info(val)
+                    if is_lock and cls is not None and \
+                            isinstance(node, ast.Assign):
+                        # chained per-key insert: lk = self.D[k] = ctor
+                        for t in node.targets:
+                            if isinstance(t, ast.Subscript) and \
+                                    isinstance(t.value, ast.Attribute):
+                                sid = f"{cls}.{t.value.attr}"
+                                if sid in self.locks:
+                                    return sid
+            return None
+        if isinstance(expr, ast.Subscript):
+            return self._dict_lock(mi, cls, expr.value)
+        if isinstance(expr, ast.Call):
+            # self.D.get(k) on a per-key dict, or a lock-getter call
+            f = expr.func
+            if isinstance(f, ast.Attribute) and f.attr == "get":
+                return self._dict_lock(mi, cls, f.value)
+            qual = self._resolve_call(mi, cls, fn, expr)
+            if isinstance(qual, str) and qual in self.lock_getters:
+                return self.lock_getters[qual]
+        return None
+
+    def _dict_lock(self, mi, cls, expr):
+        """static_id when `expr` is a per-key lock dict (self.D)."""
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id == "self" and cls is not None:
+            sid = f"{cls}.{expr.attr}"
+            ld = self.locks.get(sid)
+            if ld is not None and ld.per_key:
+                return sid
+        return None
+
+    # ------------------------------------------------------ resolution
+
+    def _resolve_class_name(self, mi, cname):
+        """Class qual for a (possibly dotted) name used in module mi."""
+        if cname is None:
+            return None
+        parts = cname.split(".")
+        head = parts[0]
+        target = mi.imports.get(head)
+        if target is not None:
+            cand = ".".join([target] + parts[1:])
+            if cand in self.class_index:
+                return cand
+            # `from x import mod` then mod.Class
+        cand = f"{mi.mod}.{cname}"
+        if cand in self.class_index:
+            return cand
+        if cname in self.class_index:
+            return cname
+        quals = self.class_by_name.get(parts[-1])
+        if quals and len(quals) == 1:
+            return quals[0]
+        return None
+
+    def _local_types(self, mi, cls, fn):
+        """name -> class qual for locals with inferable types (memoized
+        per function on the node)."""
+        cached = getattr(fn, "_lockdep_local_types", None)
+        if cached is not None:
+            return cached
+        types: dict[str, str] = {}
+        # parameter annotations
+        args = list(fn.args.posonlyargs) + list(fn.args.args) + \
+            list(fn.args.kwonlyargs)
+        for a in args:
+            anns = _annotation_classes(a.annotation)
+            if len(anns) == 1:
+                cq = self._resolve_class_name(mi, anns[0])
+                if cq:
+                    types[a.arg] = cq
+        for node in walk_skip_defs(fn):
+            tgt = None
+            value = None
+            ann = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                tgt, value = node.targets[0].id, node.value
+            elif isinstance(node, ast.AnnAssign) and \
+                    isinstance(node.target, ast.Name):
+                tgt, value, ann = node.target.id, node.value, \
+                    node.annotation
+            elif isinstance(node, (ast.For, ast.AsyncFor)) and \
+                    isinstance(node.target, ast.Name):
+                # `for x in <expr typed list[T]>` — element type
+                cq = self._element_type(mi, cls, fn, node.iter, types)
+                if cq:
+                    types[node.target.id] = cq
+                continue
+            if tgt is None:
+                continue
+            if ann is not None:
+                anns = _annotation_classes(ann)
+                if len(anns) == 1:
+                    cq = self._resolve_class_name(mi, anns[0])
+                    if cq:
+                        types[tgt] = cq
+                        continue
+            if isinstance(value, ast.Call):
+                cq = self._call_result_class(mi, cls, fn, value, types)
+                if cq:
+                    types[tgt] = cq
+        fn._lockdep_local_types = types
+        return types
+
+    def _call_result_class(self, mi, cls, fn, call, types):
+        """Class qual a call returns: a constructor, or a function with a
+        single-class return annotation."""
+        fname = dotted(call.func)
+        cq = self._resolve_class_name(mi, fname) if fname else None
+        if cq:
+            return cq
+        qual = self._resolve_call(mi, cls, fn, call, types)
+        if isinstance(qual, str):
+            ret = self.returns_class.get(qual)
+            if ret:
+                owner_mi = self.func_index[qual][0]
+                return self._resolve_class_name(owner_mi, ret)
+        return None
+
+    def _element_type(self, mi, cls, fn, expr, types):
+        """Element class of an iterated expression, from return/attr
+        annotations like `-> list[MCPSession]`."""
+        if isinstance(expr, ast.Call):
+            return self._call_result_class(mi, cls, fn, expr, types)
+        if isinstance(expr, ast.Name):
+            return types.get(expr.id)
+        return None
+
+    def _resolve_call(self, mi, cls, fn, call, types=None):
+        """Resolve a call to a function qual, a list of quals (CHA
+        fan-out), or None (not package code).  Returns "?" for calls
+        that SHOULD be package code but could not be resolved."""
+        f = call.func
+        if isinstance(f, ast.Name):
+            name = f.id
+            if name in BUILTINS:
+                return None
+            target = mi.imports.get(name)
+            if target is not None:
+                if target in self.func_index:
+                    return target
+                root = target.split(".")[0]
+                if root in IGNORED_ROOTS:
+                    return None
+                cq = self._resolve_class_name(mi, name)
+                if cq:
+                    return self._ctor_of(cq)
+                return "?" if target.startswith(self._pkg_roots()) else None
+            qual = f"{mi.mod}.{name}"
+            if qual in self.func_index:
+                return qual
+            cq = self._resolve_class_name(mi, name)
+            if cq:
+                return self._ctor_of(cq)
+            return None
+        if not isinstance(f, ast.Attribute):
+            return "?"
+        attr = f.attr
+        recv = f.value
+        if isinstance(recv, (ast.Constant, ast.JoinedStr)):
+            return None    # "...".join(...) and friends
+        # self.method()
+        if isinstance(recv, ast.Name) and recv.id == "self" and cls:
+            qual = f"{cls}.{attr}"
+            if qual in self.func_index:
+                return qual
+            # inherited methods: single in-package definition of the name
+            return self._cha(attr, allow_single=True)
+        # module.func() through imports
+        chain = dotted(f)
+        if chain:
+            head = chain.split(".")[0]
+            if head in IGNORED_ROOTS:
+                return None
+            target = mi.imports.get(head)
+            if target is not None:
+                cand = target + chain[len(head):]
+                if cand in self.func_index:
+                    return cand
+                root = target.split(".")[0]
+                if root in IGNORED_ROOTS:
+                    return None
+            cand = f"{mi.mod}.{chain}"
+            if cand in self.func_index:
+                return cand
+        # typed receiver: self.attr.m(), local.m(), ClassName.m()
+        recv_cls = None
+        if isinstance(recv, ast.Attribute) and \
+                isinstance(recv.value, ast.Name) and \
+                recv.value.id == "self" and cls:
+            owner = self.class_index[cls][0] if cls in self.class_index \
+                else mi
+            cname = owner.attr_types.get((cls, recv.attr))
+            if cname:
+                recv_cls = self._resolve_class_name(owner, cname)
+        elif isinstance(recv, ast.Name):
+            if types is None:
+                types = self._local_types(mi, cls, fn)
+            recv_cls = types.get(recv.id)
+            if recv_cls is None:
+                recv_cls = self._resolve_class_name(mi, recv.id) \
+                    if recv.id[:1].isupper() else None
+        elif isinstance(recv, ast.Call):
+            recv_cls = self._call_result_class(mi, cls, fn, recv,
+                                               types or {})
+        if recv_cls:
+            qual = f"{recv_cls}.{attr}"
+            if qual in self.func_index:
+                return qual
+            return None    # known type, unknown method (dataclass field..)
+        if attr in SAFE_METHODS:
+            return None
+        return self._cha(attr)
+
+    def _cha(self, attr, allow_single=False):
+        """Bounded class-hierarchy fan-out: all in-package methods named
+        `attr` (≤ MAX_CHA, else unresolved)."""
+        impls = self.methods_by_name.get(attr, [])
+        if not impls:
+            return "?"
+        if allow_single and len(impls) == 1:
+            return impls[0][1]
+        if len(impls) <= MAX_CHA:
+            return [q for _c, q in impls]
+        return "?"
+
+    def _ctor_of(self, cq):
+        qual = f"{cq}.__init__"
+        return qual if qual in self.func_index else None
+
+    _pkg_cache = None
+
+    def _pkg_roots(self):
+        if self._pkg_cache is None:
+            self._pkg_cache = tuple({m.split(".")[0]
+                                     for m in self.modules}) or ("",)
+        return self._pkg_cache
+
+    # ------------------------------------------------------- summaries
+
+    def summary(self, qual: str) -> dict:
+        """{acquires: {static_id: via}, blocking: {(reason, via)},
+        unknown: set} — transitive effects of calling `qual`."""
+        memo = self.summaries.get(qual)
+        if memo is not None:
+            return memo
+        if qual in self._in_progress:    # recursion: fixpoint at empty
+            return {"acquires": {}, "blocking": set(), "unknown": set()}
+        self._in_progress.add(qual)
+        mi, fn = self.func_index[qual]
+        cls = qual.rsplit(".", 1)[0]
+        cls = cls if cls in self.class_index else None
+        eff = {"acquires": {}, "blocking": set(), "unknown": set()}
+        short = qual.rsplit(".", 2)
+        short = ".".join(short[-2:]) if len(short) >= 2 else qual
+
+        def add_call_effects(call, lineno):
+            resolved = self._resolve_call(mi, cls, fn, call)
+            quals = resolved if isinstance(resolved, list) else \
+                ([resolved] if isinstance(resolved, str)
+                 and resolved != "?" else [])
+            if resolved == "?":
+                nm = dotted(call.func) or getattr(call.func, "attr", "?")
+                eff["unknown"].add(nm)
+            tag = "?" if isinstance(resolved, list) else ""
+            for q in quals:
+                if q is None:
+                    continue
+                sub = self.summary(q)
+                for sid, via in sub["acquires"].items():
+                    eff["acquires"].setdefault(
+                        sid, f"{short}:{lineno} -> {via}")
+                for reason, via in sub["blocking"]:
+                    hop = f"{short}:{lineno} ->{tag} {via}"
+                    if hop.count("->") <= MAX_BLOCK_DEPTH:
+                        eff["blocking"].add((reason, hop))
+                eff["unknown"] |= sub["unknown"]
+
+        for node in walk_skip_defs(fn):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    sid = self._lock_id_of_expr(mi, cls, fn,
+                                                item.context_expr)
+                    if sid is not None:
+                        eff["acquires"].setdefault(
+                            sid, f"{short}:{node.lineno}")
+            elif isinstance(node, ast.Call):
+                # lk.acquire() on a discovered lock
+                if isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "acquire":
+                    sid = self._lock_id_of_expr(mi, cls, fn,
+                                                node.func.value)
+                    if sid is not None:
+                        eff["acquires"].setdefault(
+                            sid, f"{short}:{node.lineno}")
+                        continue
+                reason = _blocking_reason(node)
+                if reason:
+                    eff["blocking"].add(
+                        (reason, f"{short}:{node.lineno}"))
+                    continue
+                add_call_effects(node, node.lineno)
+        self._in_progress.discard(qual)
+        self.summaries[qual] = eff
+        return eff
+
+    # ---------------------------------------------------------- checks
+
+    def check(self) -> None:
+        for sid, ld in sorted(self.locks.items()):
+            if not ld.name and ld.path.startswith("localai_tpu/"):
+                self.violations.append(Violation(
+                    ld.path, ld.line, "unranked-lock",
+                    f"lock {sid} has no hierarchy name — create it via "
+                    f"lockdep_lock(\"<name>\") and rank the name in "
+                    f"tools/lockdep/hierarchy.py (see the README "
+                    f"'adding a new lock' checklist)"))
+            elif ld.name and ld.rank is None \
+                    and ld.path.startswith("localai_tpu/"):
+                self.violations.append(Violation(
+                    ld.path, ld.line, "unranked-lock",
+                    f"lock name {ld.name!r} is not ranked in "
+                    f"tools/lockdep/hierarchy.py"))
+        for qual in sorted(self.func_index):
+            self._check_function(qual)
+        self._check_cycles()
+
+    def _check_function(self, qual: str) -> None:
+        mi, fn = self.func_index[qual]
+        cls = qual.rsplit(".", 1)[0]
+        cls = cls if cls in self.class_index else None
+
+        def visit(node, held):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                new_locks = []
+                for item in node.items:
+                    visit(item.context_expr, held)   # pre-acquire effects
+                    sid = self._lock_id_of_expr(mi, cls, fn,
+                                                item.context_expr)
+                    if sid is not None:
+                        self._on_acquire(mi, qual, sid, node.lineno,
+                                         held, via="")
+                        new_locks.append(sid)
+                for stmt in node.body:
+                    visit(stmt, held + new_locks)
+                return
+            if isinstance(node, ast.Call):
+                self._on_call(mi, cls, fn, qual, node, held)
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        for stmt in fn.body:
+            visit(stmt, [])
+
+    def _on_call(self, mi, cls, fn, qual, call, held) -> None:
+        # bare lk.acquire() counts as an acquisition event
+        if isinstance(call.func, ast.Attribute) and \
+                call.func.attr == "acquire":
+            sid = self._lock_id_of_expr(mi, cls, fn, call.func.value)
+            if sid is not None:
+                self._on_acquire(mi, qual, sid, call.lineno, held, via="")
+                return
+        if _blocking_reason(call):
+            return          # direct blocking-under-lock is lint's rule
+        resolved = self._resolve_call(mi, cls, fn, call)
+        if resolved == "?":
+            nm = dotted(call.func) or getattr(call.func, "attr", "?")
+            self.unknown_calls[nm] = self.unknown_calls.get(nm, 0) + 1
+            for h in held:
+                self.unknown_edges[(self._label(h), f"?{nm}()")] = \
+                    self.unknown_edges.get(
+                        (self._label(h), f"?{nm}()"), 0) + 1
+            return
+        quals = resolved if isinstance(resolved, list) else \
+            ([resolved] if isinstance(resolved, str) else [])
+        maybe = " (possible receiver)" if isinstance(resolved, list) \
+            else ""
+        for q in quals:
+            sub = self.summary(q)
+            for sid, via in sub["acquires"].items():
+                self._on_acquire(mi, qual, sid, call.lineno, held,
+                                 via=f" via {via}{maybe}")
+            if held:
+                for reason, via in sub["blocking"]:
+                    self.violations.append(Violation(
+                        mi.path, call.lineno, "lock-blocking",
+                        f"{reason} reachable while holding "
+                        f"{self._label(held[-1])!r}: {via}{maybe} — "
+                        f"snapshot under the lock, block outside it"))
+
+    def _label(self, sid: str) -> str:
+        ld = self.locks.get(sid)
+        return ld.label if ld else sid
+
+    def _on_acquire(self, mi, qual, sid, lineno, held, via) -> None:
+        ld = self.locks.get(sid)
+        if ld is None:
+            return
+        for h in held:
+            hd = self.locks.get(h)
+            if hd is None:
+                continue
+            if h == sid or (hd.name and hd.name == ld.name):
+                if ld.reentrant and h == sid:
+                    continue
+                kind = ("per-key class" if ld.per_key else "lock")
+                self.violations.append(Violation(
+                    mi.path, lineno, "lock-self",
+                    f"{ld.label!r} acquired while the same {kind} is "
+                    f"already held{via} — "
+                    + ("two keys of one per-key class nest: ABBA "
+                       "deadlock between threads"
+                       if ld.per_key else "self-deadlock")))
+                continue
+            self.edges.setdefault((hd.label, ld.label), []).append(
+                (mi.path, lineno, via))
+            if hd.rank is not None and ld.rank is not None \
+                    and hd.rank >= ld.rank:
+                self.violations.append(Violation(
+                    mi.path, lineno, "lock-order",
+                    f"{ld.label!r} (rank {ld.rank}) acquired while "
+                    f"holding {hd.label!r} (rank {hd.rank}){via} — "
+                    f"the hierarchy requires {ld.label!r} outside "
+                    f"{hd.label!r}; see tools/lockdep/hierarchy.py"))
+
+    def _check_cycles(self) -> None:
+        """Cycles among edges the rank check could not adjudicate (at
+        least one unranked endpoint)."""
+        ranked = hierarchy.RANKS
+        adj: dict[str, set[str]] = {}
+        for (a, b) in self.edges:
+            if a in ranked and b in ranked:
+                continue   # rank check owns fully-ranked edges
+            adj.setdefault(a, set()).add(b)
+        state: dict[str, int] = {}
+        stack: list[str] = []
+
+        def dfs(n):
+            state[n] = 1
+            stack.append(n)
+            for m in sorted(adj.get(n, ())):
+                if state.get(m, 0) == 1:
+                    cyc = stack[stack.index(m):] + [m]
+                    path, line, _via = self.edges[(n, m)][0]
+                    self.violations.append(Violation(
+                        path, line, "lock-cycle",
+                        "acquired-while-held cycle: "
+                        + " -> ".join(cyc)))
+                elif state.get(m, 0) == 0:
+                    dfs(m)
+            stack.pop()
+            state[n] = 2
+
+        for n in sorted(adj):
+            if state.get(n, 0) == 0:
+                dfs(n)
+
+    # ----------------------------------------------------- suppression
+
+    def filtered(self) -> list[Violation]:
+        """Apply `# lockdep: allow(...)` pragmas; emit bad-pragma and
+        stale-pragma for the pragma hygiene itself."""
+        out: list[Violation] = []
+        by_path: dict[str, list[Violation]] = {}
+        for v in self.violations:
+            by_path.setdefault(v.path, []).append(v)
+        for path, src in self.files.items():
+            allowed, raw = collect_pragmas(src, tag="lockdep")
+            vs = by_path.pop(path, [])
+            # contributors[line][name] = pragma lines granting `name` there
+            contributors: dict[int, dict[str, set[int]]] = {}
+            src_lines = src.splitlines()
+            for pln, names_raw in raw:
+                names = {n.strip() for n in names_raw.split(",")
+                         if n.strip()}
+                covers = {pln}
+                text = src_lines[pln - 1] if pln <= len(src_lines) else ""
+                if text.lstrip().startswith("#"):   # standalone pragma
+                    nxt = pln
+                    while nxt < len(src_lines):
+                        stripped = src_lines[nxt].strip()
+                        if stripped and not stripped.startswith("#"):
+                            covers.add(nxt + 1)
+                            break
+                        nxt += 1
+                for ln in covers:
+                    for name in names:
+                        contributors.setdefault(ln, {}).setdefault(
+                            name, set()).add(pln)
+            spans: list[tuple[int, int]] = []
+            try:
+                tree = ast.parse(src)
+            except SyntaxError:
+                tree = None
+            if tree is not None:
+                for node in ast.walk(tree):
+                    if isinstance(node, ast.stmt) and \
+                            getattr(node, "end_lineno", None):
+                        spans.append((node.lineno, node.end_lineno))
+
+            def pragma_lines(line):
+                """Lines whose pragmas cover `line` (own line + the
+                enclosing innermost statement's lines)."""
+                cover = {line}
+                best = None
+                for s, e in spans:
+                    if s <= line <= e and (best is None or
+                                           (e - s) < (best[1] - best[0])):
+                        best = (s, e)
+                if best:
+                    cover.update(range(best[0], best[1] + 1))
+                return cover
+
+            used: set[tuple[int, str]] = set()
+            for v in vs:
+                sup = False
+                for ln in pragma_lines(v.line):
+                    plns = contributors.get(ln, {}).get(v.rule)
+                    if plns:
+                        used.update((p, v.rule) for p in plns)
+                        sup = True
+                if not sup:
+                    out.append(v)
+            for pln, names_raw in raw:
+                for name in (n.strip() for n in names_raw.split(",")):
+                    if not name:
+                        continue
+                    if name not in CHECKS:
+                        out.append(Violation(
+                            path, pln, "bad-pragma",
+                            f"lockdep pragma allows unknown check "
+                            f"{name!r}; known: "
+                            f"{', '.join(sorted(CHECKS))}"))
+                    elif (pln, name) not in used:
+                        out.append(Violation(
+                            path, pln, "stale-pragma",
+                            f"lockdep pragma allow({name}) suppresses "
+                            f"nothing — remove it (stale allowlists rot)"))
+        for vs in by_path.values():
+            out.extend(vs)
+        out.sort(key=lambda v: (v.path, v.line, v.rule))
+        return out
+
+
+def run_paths(targets: list[str], root: str | None = None):
+    """Analyze every .py file under `targets`; returns (violations,
+    analyzer) — violations already pragma-filtered."""
+    root = os.path.abspath(root or find_root(targets[0] if targets
+                                             else "."))
+    an = Analyzer(root)
+    an.load(targets)
+    an.check()
+    return an.filtered(), an
